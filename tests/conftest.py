@@ -1,0 +1,20 @@
+"""Test harness config.
+
+Mirrors the reference's test philosophy (SURVEY §4): the reference runs every
+test on a real in-process Flink mini-cluster; we run every test on a real
+8-device XLA CPU mesh (``--xla_force_host_platform_device_count=8``) so
+shardings/collectives execute genuinely, and enable x64 so golden comparisons
+against the float64 NumPy oracle are meaningful.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: never run unit tests on the TPU chip
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,146 @@
+"""Independent float64 NumPy oracle for golden-value testing.
+
+Plays the role the van-der-Maaten Python / bhtsne C++ golden tables play in
+the reference test suite (``TsneHelpersTestSuite.scala:350,543``): a slow,
+obviously-correct implementation of each t-SNE step, written directly from the
+papers' formulas, against which every JAX op is compared.  Deliberately shares
+no code with ``tsne_flink_tpu``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def dist(a, b, metric):
+    d = a - b
+    if metric == "sqeuclidean":
+        return float(np.dot(d, d))
+    if metric == "euclidean":
+        return float(np.sqrt(np.dot(d, d)))
+    if metric == "cosine":
+        return float(1.0 - np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+    raise ValueError(metric)
+
+
+def dist_matrix(x, metric):
+    n = len(x)
+    out = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            out[i, j] = dist(x[i], x[j], metric)
+    return out
+
+
+def knn(x, k, metric):
+    d = dist_matrix(x, metric)
+    np.fill_diagonal(d, np.inf)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return idx, np.take_along_axis(d, idx, axis=1)
+
+
+def row_affinities(d_row, perplexity, max_steps=50, tol=1e-5):
+    """Beta bisection with the doubling/halving rule of vdM's x2p."""
+    target = np.log(perplexity)
+
+    def entropy(beta):
+        p = np.exp(-d_row * beta)
+        sp = p.sum()
+        if sp == 0.0:
+            sp = 1e-7
+        return np.log(sp) + beta * float((d_row * p).sum()) / sp
+
+    beta, lo, hi = 1.0, -np.inf, np.inf
+    for _ in range(max_steps):
+        h = entropy(beta)
+        if abs(h - target) < tol:
+            break
+        if h > target:
+            lo = beta
+            beta = beta * 2.0 if np.isinf(hi) else (beta + hi) / 2.0
+        else:
+            hi = beta
+            beta = beta / 2.0 if np.isinf(lo) else (beta + lo) / 2.0
+    p = np.exp(-d_row * beta)
+    sp = p.sum()
+    if sp == 0.0:
+        sp = 1e-7
+    return p / sp
+
+
+def affinities(d_knn, perplexity):
+    return np.stack([row_affinities(r, perplexity) for r in d_knn])
+
+
+def joint_dense(idx, p):
+    """Dense symmetrized + normalized P with the 1e-12 floor on present entries."""
+    n, k = idx.shape
+    c = np.zeros((n, n))
+    for i in range(n):
+        for s in range(k):
+            c[i, idx[i, s]] += p[i, s]
+    pm = c + c.T
+    pm /= pm.sum()
+    present = pm > 0
+    pm[present] = np.maximum(pm[present], 1e-12)
+    return pm
+
+
+def gradient(pm, y, metric, exaggeration=1.0):
+    """Exact (theta=0) gradient + KL loss: grad_i = sum_j P q (yi-yj) - rep_i/Z."""
+    n, m = y.shape
+    pe = pm * exaggeration
+    q_att = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                q_att[i, j] = 1.0 / (1.0 + dist(y[i], y[j], metric))
+    q_rep = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                q_rep[i, j] = 1.0 / (1.0 + dist(y[i], y[j], "sqeuclidean"))
+    z = q_rep.sum()
+    grad = np.zeros((n, m))
+    loss = 0.0
+    for i in range(n):
+        att = np.zeros(m)
+        rep = np.zeros(m)
+        for j in range(n):
+            if i == j:
+                continue
+            att += pe[i, j] * q_att[i, j] * (y[i] - y[j])
+            rep += q_rep[i, j] ** 2 * (y[i] - y[j])
+            if pe[i, j] > 0:
+                loss += pe[i, j] * np.log(pe[i, j] / (q_att[i, j] / z))
+        grad[i] = att - rep / z
+    return grad, loss
+
+
+def update(y, upd, gains, grad, momentum, lr, min_gain=0.01):
+    same = (grad > 0.0) == (upd > 0.0)
+    gains = np.where(same, gains * 0.8, gains + 0.2)
+    gains = np.maximum(gains, min_gain)
+    upd = momentum * upd - lr * gains * grad
+    y = y + upd
+    y = y - y.mean(axis=0)
+    return y, upd, gains
+
+
+def run(pm, y0, iterations, metric="sqeuclidean", lr=1000.0,
+        early_exaggeration=4.0, m0=0.5, m1=0.8):
+    """Full 3-phase optimization; returns (y, {iter_1based: loss})."""
+    y = y0.copy()
+    upd = np.zeros_like(y)
+    gains = np.ones_like(y)
+    losses = {}
+    p1 = min(iterations, 20)
+    pe_end = min(iterations, 101)
+    for i in range(iterations):
+        momentum = m0 if i < p1 else m1
+        exag = early_exaggeration if i < pe_end else 1.0
+        grad, loss = gradient(pm, y, metric, exag)
+        if (i + 1) % 10 == 0:
+            losses[i + 1] = loss
+        y, upd, gains = update(y, upd, gains, grad, momentum, lr)
+    return y, losses

@@ -1,0 +1,35 @@
+"""tsne_flink_tpu — a TPU-native Barnes-Hut t-SNE framework (JAX / XLA / pjit).
+
+A ground-up reimplementation of the capabilities of the reference
+``ChristophAl/tsne-flink`` (a Scala/Flink batch-dataflow Barnes-Hut t-SNE,
+see ``/root/reference``), redesigned for TPU:
+
+* Flink dataflow shuffles        -> SPMD over a ``jax.sharding.Mesh`` (pjit/GSPMD)
+* Breeze + netlib BLAS           -> jax.numpy on XLA (MXU matmuls)
+* pointer-chasing 2-D QuadTree   -> tiled exact / implicit-grid BH / FFT-interpolation
+                                    repulsion in regular arrays
+* per-group beta binary search   -> one vmapped fixed-trip bisection over all rows
+* three chained bulk iterations  -> one ``lax.fori_loop`` with iteration-gated
+                                    momentum / early-exaggeration switches
+
+Public API re-exports the high-level entry points.
+"""
+
+from tsne_flink_tpu.models.tsne import (  # noqa: F401
+    TsneConfig,
+    TsneState,
+    init_working_set,
+    optimize,
+    tsne_embed,
+)
+from tsne_flink_tpu.ops.knn import (  # noqa: F401
+    knn_bruteforce,
+    knn_partition,
+    knn_project,
+)
+from tsne_flink_tpu.ops.affinities import (  # noqa: F401
+    pairwise_affinities,
+    joint_distribution,
+)
+
+__version__ = "0.1.0"

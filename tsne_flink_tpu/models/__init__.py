@@ -1,0 +1,1 @@
+"""Model layer: the t-SNE optimizer state machine and high-level pipeline."""

@@ -1,0 +1,229 @@
+"""The t-SNE optimizer: gradient, adaptive-gains update, 3-phase schedule.
+
+Reference parity map (all in ``TsneHelpers.scala`` unless noted):
+
+* working set (id, y, lastUpdate, gains)        :198-219  -> :class:`TsneState`
+* gradient = attraction − repulsion/Z           :221-318  -> :func:`_gradient`
+* adaptive gains + momentum update              :341-369  -> :func:`_update_embedding`
+* per-iteration mean centering                  :320-329  -> :func:`_center`
+* bulk iteration                                :371-394  -> one ``lax.fori_loop``
+* 3-phase schedule (early exaggeration/momentum):396-430  -> iteration-gated
+  ``jnp.where`` switches inside the SAME compiled loop (the reference compiles
+  three separate Flink bulk iterations; phase boundaries are
+  p1 = min(iters, 20) for the momentum switch and min(iters, 101) for the end
+  of early exaggeration — :403-405)
+* KL loss every 10th iteration into a keyed accumulator
+  (:297-300, ``MapAccumulator.java:27``) -> a dense on-device loss trace,
+  slot t <=> global 1-based iteration 10·(t+1), psum'd across the mesh.
+
+SPMD: every function operates on the LOCAL row shard of the point axis and
+takes an optional ``axis_name``; inside ``shard_map`` the embedding is
+all-gathered (replacing the reference's O(N)-per-task full-embedding Java-Map
+broadcast, ``TsneHelpers.scala:277-278``) and the scalar reductions
+(Z, loss, mean) become ``lax.psum`` over ICI (replacing Flink global reduces).
+With ``axis_name=None`` the same code runs single-device with zero overhead.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+from tsne_flink_tpu.ops.knn import knn as knn_dispatch
+from tsne_flink_tpu.ops.metrics import metric_fn
+from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
+
+LOSS_EVERY = 10  # TsneHelpers.scala:297
+
+
+@dataclass(frozen=True)
+class TsneConfig:
+    """Hyper-parameters; names/defaults mirror the CLI table at Tsne.scala:39-63."""
+
+    n_components: int = 2
+    perplexity: float = 30.0
+    early_exaggeration: float = 4.0
+    learning_rate: float = 1000.0
+    iterations: int = 300
+    initial_momentum: float = 0.5
+    final_momentum: float = 0.8
+    theta: float = 0.25
+    metric: str = "sqeuclidean"
+    min_gain: float = 0.01  # TsneHelpers.scala:386
+    repulsion: str = "exact"  # exact | bh | fft
+    row_chunk: int = 2048
+
+    @property
+    def momentum_switch(self) -> int:
+        return min(self.iterations, 20)  # TsneHelpers.scala:403
+
+    @property
+    def exaggeration_end(self) -> int:
+        return min(self.iterations, 101)  # TsneHelpers.scala:403-405
+
+    @property
+    def n_loss_slots(self) -> int:
+        return self.iterations // LOSS_EVERY
+
+
+class TsneState(NamedTuple):
+    """(y, lastUpdate, gains) — the reference working-set 4-tuple minus the id
+    column, which becomes the array index (TsneHelpers.scala:199,216)."""
+
+    y: jnp.ndarray        # [N, m]
+    update: jnp.ndarray   # [N, m]
+    gains: jnp.ndarray    # [N, m]
+
+
+def init_working_set(key: jax.Array, n: int, n_components: int = 2,
+                     dtype=jnp.float32) -> TsneState:
+    """y ~ N(0, 1e-4), update = 0, gains = 1 (TsneHelpers.scala:207-214).
+
+    Unlike the reference, the seed actually seeds (the reference accepts
+    ``randomState`` but never uses it — Tsne.scala:54, SURVEY §2.1).
+    """
+    y = (1e-4 * jax.random.normal(key, (n, n_components))).astype(dtype)
+    return TsneState(y=y, update=jnp.zeros_like(y), gains=jnp.ones_like(y))
+
+
+def _psum(x, axis_name):
+    return x if axis_name is None else lax.psum(x, axis_name)
+
+
+def _attractive_forces(y_local, y_full, jidx, jval, metric, exag, z,
+                       row_chunk=4096):
+    """F_attr_i = Σ_j P_ij q_ij (y_i − y_j), q via the CLI metric
+    (TsneHelpers.scala:284-305), plus the partial KL loss Σ p log(p/(q/Z))
+    (:297-300).  Row-chunked so the [c, S, m] gather stays in VMEM-friendly
+    tiles."""
+    nloc, m = y_local.shape
+    s = jidx.shape[1]
+    f = metric_fn(metric)
+    c = min(row_chunk, nloc)
+    nchunks = math.ceil(nloc / c)
+    pad = nchunks * c - nloc
+    yp = jnp.pad(y_local, ((0, pad), (0, 0)))
+    ip = jnp.pad(jidx, ((0, pad), (0, 0)))
+    vp = jnp.pad(jval, ((0, pad), (0, 0)))
+
+    def one_chunk(args):
+        yc, ic, vc = args
+        yj = y_full[ic]                      # [c, S, m]
+        q = 1.0 / (1.0 + f(yc[:, None, :], yj))
+        pe = vc * exag
+        w = pe * q
+        att = yc * jnp.sum(w, axis=1)[:, None] - jnp.einsum("cs,csm->cm", w, yj)
+        mask = vc > 0
+        pe_safe = jnp.where(mask, pe, 1.0)
+        q_safe = jnp.where(mask, q, 1.0)
+        loss = jnp.sum(jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0))
+        return att, loss
+
+    att, loss = lax.map(one_chunk, (yp.reshape(nchunks, c, m),
+                                    ip.reshape(nchunks, c, s),
+                                    vp.reshape(nchunks, c, s)))
+    return att.reshape(-1, m)[:nloc], jnp.sum(loss)
+
+
+def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
+              axis_name=None, row_offset=0, valid=None):
+    """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317)."""
+    y_full = (y_local if axis_name is None
+              else lax.all_gather(y_local, axis_name, tiled=True))
+    if cfg.repulsion == "exact":
+        rep, sq = exact_repulsion(y_local, y_full, row_offset=row_offset,
+                                  col_valid=valid, row_chunk=cfg.row_chunk)
+    else:
+        raise NotImplementedError(
+            f"repulsion='{cfg.repulsion}' lands in a later milestone")
+    z = _psum(sq, axis_name)
+    att, loss = _attractive_forces(y_local, y_full, jidx, jval, cfg.metric,
+                                   exag, z, row_chunk=cfg.row_chunk)
+    loss = _psum(loss, axis_name)
+    return att - rep / z, loss
+
+
+def _update_embedding(state: TsneState, grad, momentum, cfg: TsneConfig):
+    """vdM adaptive gains + momentum (TsneHelpers.scala:357-366)."""
+    same_sign = (grad > 0.0) == (state.update > 0.0)
+    gains = jnp.where(same_sign, state.gains * 0.8, state.gains + 0.2)
+    gains = jnp.maximum(gains, cfg.min_gain)
+    update = momentum * state.update - cfg.learning_rate * gains * grad
+    return TsneState(y=state.y + update, update=update, gains=gains)
+
+
+def _center(state: TsneState, axis_name=None, valid=None):
+    """Subtract the (global) mean each iteration (TsneHelpers.scala:320-329)."""
+    if valid is None:
+        total = _psum(jnp.sum(state.y, axis=0), axis_name)
+        count = _psum(jnp.asarray(state.y.shape[0], state.y.dtype), axis_name)
+    else:
+        w = valid.astype(state.y.dtype)
+        total = _psum(jnp.sum(state.y * w[:, None], axis=0), axis_name)
+        count = _psum(jnp.sum(w), axis_name)
+    return state._replace(y=state.y - total / count)
+
+
+def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
+             axis_name=None, row_offset=0, valid=None):
+    """Full 3-phase gradient descent as ONE compiled fori_loop.
+
+    Returns (final TsneState, loss trace [iterations // 10]); trace slot t is
+    the KL at global 1-based iteration 10·(t+1), matching the reference's
+    every-10th-superstep accumulator keys (TsneHelpers.scala:297-300).
+    """
+    m0 = jnp.asarray(cfg.initial_momentum, state.y.dtype)
+    m1 = jnp.asarray(cfg.final_momentum, state.y.dtype)
+    alpha = jnp.asarray(cfg.early_exaggeration, state.y.dtype)
+    one = jnp.ones((), state.y.dtype)
+    n_slots = max(cfg.n_loss_slots, 1)
+
+    def body(i, carry):
+        st, loss_arr = carry
+        momentum = jnp.where(i < cfg.momentum_switch, m0, m1)
+        exag = jnp.where(i < cfg.exaggeration_end, alpha, one)
+        grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
+                               axis_name=axis_name, row_offset=row_offset,
+                               valid=valid)
+        if valid is not None:
+            grad = grad * valid[:, None].astype(grad.dtype)
+        st = _update_embedding(st, grad, momentum, cfg)
+        st = _center(st, axis_name=axis_name, valid=valid)
+        slot = jnp.minimum((i + 1) // LOSS_EVERY - 1, n_slots - 1)
+        record = (i + 1) % LOSS_EVERY == 0
+        loss_arr = loss_arr.at[slot].set(
+            jnp.where(record, loss, loss_arr[slot]))
+        return st, loss_arr
+
+    loss0 = jnp.zeros((n_slots,), state.y.dtype)
+    state, losses = lax.fori_loop(0, cfg.iterations, body, (state, loss0))
+    return state, losses
+
+
+def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
+               neighbors: int | None = None, knn_method: str = "bruteforce",
+               knn_blocks: int = 8, knn_iterations: int = 3, seed: int = 0,
+               sym_width: int | None = None):
+    """Single-device end-to-end pipeline (the ``computeEmbedding`` analog,
+    Tsne.scala:105-136): kNN -> β-calibrated affinities -> symmetrized P ->
+    init -> optimize.  Returns (embedding [N, m], loss trace)."""
+    cfg = cfg or TsneConfig()
+    n = x.shape[0]
+    k = neighbors if neighbors is not None else 3 * int(cfg.perplexity)
+    key = jax.random.key(seed)
+    kkey, ikey = jax.random.split(key)
+    idx, dist = knn_dispatch(x, k, knn_method, cfg.metric,
+                             blocks=knn_blocks, rounds=knn_iterations, key=kkey)
+    p_cond = pairwise_affinities(dist, cfg.perplexity)
+    jidx, jval = joint_distribution(idx, p_cond, sym_width)
+    state = init_working_set(ikey, n, cfg.n_components, x.dtype)
+    run = jax.jit(partial(optimize, cfg=cfg))
+    state, losses = run(state, jidx, jval)
+    return state.y, losses

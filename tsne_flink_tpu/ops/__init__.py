@@ -1,0 +1,1 @@
+"""Core numerical ops: metrics, kNN strategies, Z-order, affinities, repulsion."""

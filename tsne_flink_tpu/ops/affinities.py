@@ -1,0 +1,143 @@
+"""High-dimensional affinities: perplexity calibration + joint distribution.
+
+Parity targets in the reference:
+
+* ``pairwiseAffinities`` (``TsneHelpers.scala:162-180``) — per-point binary
+  search for beta = 1/(2 sigma²) such that the row entropy H equals
+  log(perplexity); 50 max refinements, tolerance 1e-5, with doubling/halving
+  while the bracket is unbounded (``approximateBeta``, ``TsneHelpers.scala:443-484``),
+  the 1e-7 zero-sum guard (``computeH``/``computeP``, :490-504``), and final
+  row-normalized p_j|i.  The reference runs one sequential recursion per Flink
+  group; here ALL rows advance together as one vmapped fixed-trip ``fori_loop``
+  — each step is a masked update, converged rows freeze.
+* ``jointDistribution`` (``TsneHelpers.scala:182-196``) — P_ij = p_j|i + p_i|j,
+  normalized by the global sum.  The reference's union/groupBy/reduce COO
+  shuffle becomes a single ``lax.sort`` by (i, j) + run-length segment-sum,
+  scattered into a fixed-width padded row layout [N, S] (fixed k makes row
+  width bounded by construction; S defaults to 2k).  NOTE the reference's
+  ``max(x, Double.MinValue)`` at ``TsneHelpers.scala:191,194`` is a no-op
+  (Scala's Double.MinValue is -1.8e308); the intended van-der-Maaten 1e-12
+  floor is applied here for real.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: reference constants (TsneHelpers.scala:445, :486, :493)
+MAX_BISECT_STEPS = 50
+H_TOL = 1e-5
+ZERO_SUM_GUARD = 1e-7
+P_FLOOR = 1e-12  # the intended clamp at TsneHelpers.scala:191,194
+
+
+def _row_entropy(d, valid, beta, dtype):
+    p = jnp.where(valid, jnp.exp(-d * beta), jnp.zeros((), dtype))
+    sum_p = jnp.sum(p)
+    sum_p = jnp.where(sum_p == 0.0, jnp.asarray(ZERO_SUM_GUARD, dtype), sum_p)
+    h = jnp.log(sum_p) + beta * jnp.sum(d * p) / sum_p
+    return h, p, sum_p
+
+
+def pairwise_affinities(dist: jnp.ndarray, perplexity: float) -> jnp.ndarray:
+    """Row-calibrated conditional affinities p_j|i.
+
+    ``dist`` is the [N, k] kNN distance matrix (whatever metric produced it —
+    the reference likewise feeds the raw kNN distances in).  Non-finite entries
+    (padding of approximate kNN) are excluded from the search and get p = 0.
+
+    Returns [N, k] with each valid row summing to 1.
+    """
+    dtype = dist.dtype
+    target = jnp.asarray(math.log(perplexity), dtype)
+    valid = jnp.isfinite(dist)
+    d = jnp.where(valid, dist, jnp.zeros((), dtype))
+
+    def row(d_row, valid_row):
+        def body(_, st):
+            beta, lo, hi, done = st
+            h, _, _ = _row_entropy(d_row, valid_row, beta, dtype)
+            done = done | (jnp.abs(h - target) < H_TOL)
+            pos = h - target > 0  # entropy too high -> raise beta
+            n_lo = jnp.where(pos, beta, lo)
+            n_hi = jnp.where(pos, hi, beta)
+            n_beta = jnp.where(
+                pos,
+                jnp.where(jnp.isinf(hi), beta * 2.0, (beta + hi) / 2.0),
+                jnp.where(jnp.isinf(lo), beta / 2.0, (beta + lo) / 2.0),
+            )
+            return (jnp.where(done, beta, n_beta),
+                    jnp.where(done, lo, n_lo),
+                    jnp.where(done, hi, n_hi),
+                    done)
+
+        init = (jnp.asarray(1.0, dtype), jnp.asarray(-jnp.inf, dtype),
+                jnp.asarray(jnp.inf, dtype), jnp.asarray(False))
+        beta, _, _, _ = lax.fori_loop(0, MAX_BISECT_STEPS, body, init)
+        _, p, sum_p = _row_entropy(d_row, valid_row, beta, dtype)
+        return p / sum_p
+
+    return jax.vmap(row)(d, valid)
+
+
+def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
+                       sym_width: int | None = None):
+    """Symmetrize + globally normalize: P_ij = (p_j|i + p_i|j) / ΣP.
+
+    Input: kNN structure ``idx`` [N, k] (int32) and conditional affinities
+    ``p`` [N, k] (entries with p == 0 are treated as absent).  Output:
+    ``(jidx, jval)`` both [N, S] (S = ``sym_width`` or 2k), rows sorted by
+    neighbor id, padded with (idx=0, val=0.0).  Valid entries carry
+    val >= 1e-12, so ``jval > 0`` is the validity mask.
+
+    Should a row overflow S distinct neighbors (possible for hub points whose
+    in-degree exceeds k), the largest-id entries are dropped; the normalizer
+    uses the kept entries so that ΣP == 1 holds exactly either way.
+    """
+    n, k = idx.shape
+    s = int(sym_width) if sym_width is not None else 2 * k
+    dtype = p.dtype
+
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k))
+    cols = idx.astype(jnp.int32)
+    present = p > 0
+
+    # forward + transposed edge lists; absent edges get row id n (sorts last,
+    # lands in the dump row of the scatter below)
+    ii = jnp.concatenate([jnp.where(present, rows, n).reshape(-1),
+                          jnp.where(present, cols, n).reshape(-1)])
+    jj = jnp.concatenate([cols.reshape(-1), rows.reshape(-1)])
+    vv = jnp.concatenate([p.reshape(-1), p.reshape(-1)])
+
+    ii, jj, vv = lax.sort((ii, jj, vv), num_keys=2)
+    e = ii.shape[0]
+
+    # run-length merge of duplicate (i, j): the reference's groupBy(0,1).reduce(+)
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             (ii[1:] != ii[:-1]) | (jj[1:] != jj[:-1])])
+    run = jnp.cumsum(first) - 1  # run id per entry
+    run_sum = jax.ops.segment_sum(vv, run, num_segments=e)  # at run ordinal
+    run_sum_at_entry = run_sum[run]
+
+    # column slot of each run within its row = run ordinal - first run ordinal of row
+    row_first = jnp.concatenate([jnp.ones((1,), bool), ii[1:] != ii[:-1]])
+    row_start_run = lax.cummax(jnp.where(row_first, run, 0))
+    col = run - row_start_run
+
+    keep = first & (col < s) & (ii < n)
+    scat_row = jnp.where(keep, ii, n)  # dump row n
+    jidx = jnp.zeros((n + 1, s), jnp.int32).at[scat_row, col].set(
+        jj, mode="drop")[:n]
+    jval = jnp.zeros((n + 1, s), dtype).at[scat_row, col].set(
+        jnp.where(keep, run_sum_at_entry, 0.0), mode="drop")[:n]
+
+    sum_p = jnp.sum(jval)
+    valid = jval > 0
+    jval = jnp.where(valid, jnp.maximum(jval / sum_p, P_FLOOR),
+                     jnp.zeros((), dtype))
+    jidx = jnp.where(valid, jidx, 0)
+    return jidx, jval

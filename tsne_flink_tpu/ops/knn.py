@@ -1,0 +1,224 @@
+"""k-nearest-neighbor strategies.
+
+Parity with the reference's three selectable kNN methods (dispatch at
+``Tsne.scala:74-79``), re-designed for the MXU instead of translated:
+
+* ``bruteforce`` (``TsneHelpers.scala:41-59``): Flink ``cross`` + per-group
+  sort/first(k)  ->  row-chunked ``‖a‖²+‖b‖²−2abᵀ`` distance tiles + ``lax.top_k``.
+* ``partition``  (``TsneHelpers.scala:61-91``): blocked cross with block-local
+  all-pairs + global top-k  ->  the same distance tiles with an explicit
+  column-block schedule and a streaming top-k merge (never materializes [N, N];
+  this is the memory-scalable exact variant).
+* ``project``    (``TsneHelpers.scala:93-160``): rounds of random-shift Z-order
+  sorts emitting ±k window candidates, dedup, exact re-rank.  The reference
+  funnels the whole dataset through ONE sorter task per round
+  (``TsneHelpers.scala:140-144``); here each round is a data-parallel Morton-key
+  argsort (see ``zorder.py``), and dedup/re-rank are regular [N, C] array ops.
+
+All strategies return ``(neighbor_idx int32 [N, k], neighbor_dist [N, k])`` with
+rows sorted by ascending distance — the regular-array equivalent of the
+reference's COO ``(i, j, d)`` stream (fixed k makes every row the same width).
+Entries that could not be filled (only possible for ``project`` with too few
+candidate rounds) carry ``dist == +inf``; downstream consumers mask on
+``isfinite``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tsne_flink_tpu.ops.metrics import metric_fn, pairwise
+from tsne_flink_tpu.ops.zorder import zorder_permutation
+
+
+def _topk_smallest(d: jnp.ndarray, k: int):
+    """Smallest-k along the last axis -> (dist ascending, idx)."""
+    neg, idx = lax.top_k(-d, k)
+    return -neg, idx
+
+
+def _clamp_k(k: int, n: int) -> int:
+    # the reference's first(k) silently yields shorter groups when k > n-1
+    # (TsneHelpers.scala:58); we clamp to keep arrays regular.
+    return int(min(k, n - 1))
+
+
+def knn_bruteforce(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
+                   *, row_chunk: int = 1024):
+    """Exact kNN by full N×N tiles (reference bruteforce, TsneHelpers.scala:41-59)."""
+    n, dim = x.shape
+    k = _clamp_k(k, n)
+    c = min(row_chunk, n)
+    nchunks = math.ceil(n / c)
+    xp = jnp.pad(x, ((0, nchunks * c - n), (0, 0)))
+    chunks = xp.reshape(nchunks, c, dim)
+    starts = jnp.arange(nchunks, dtype=jnp.int32) * c
+    col_ids = jnp.arange(n, dtype=jnp.int32)
+
+    def one_chunk(args):
+        xc, s = args
+        dmat = pairwise(metric, xc, x)  # [c, n] — one MXU tile row
+        row_ids = s + jnp.arange(c, dtype=jnp.int32)
+        dmat = jnp.where(row_ids[:, None] == col_ids[None, :], jnp.inf, dmat)
+        return _topk_smallest(dmat, k)
+
+    dist, idx = lax.map(one_chunk, (chunks, starts))
+    return (idx.reshape(-1, k)[:n].astype(jnp.int32),
+            dist.reshape(-1, k)[:n])
+
+
+def knn_partition(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
+                  blocks: int = 8, *, row_chunk: int = 1024):
+    """Exact kNN with a column-block schedule + streaming top-k merge.
+
+    TPU-native analog of the reference's block-cross ``partitionKnn``
+    (``TsneHelpers.scala:61-91``): ``blocks`` plays the role of ``knnBlocks`` —
+    it bounds the working-set width (memory), not the result, which is
+    identical to ``bruteforce``.
+    """
+    n, dim = x.shape
+    k = _clamp_k(k, n)
+    blocks = max(1, min(blocks, n))
+    b = math.ceil(n / blocks)
+    xcols = jnp.pad(x, ((0, blocks * b - n), (0, 0))).reshape(blocks, b, dim)
+    bstarts = jnp.arange(blocks, dtype=jnp.int32) * b
+
+    c = min(row_chunk, n)
+    nchunks = math.ceil(n / c)
+    xrows = jnp.pad(x, ((0, nchunks * c - n), (0, 0))).reshape(nchunks, c, dim)
+    rstarts = jnp.arange(nchunks, dtype=jnp.int32) * c
+
+    def one_chunk(args):
+        xq, rs = args
+        row_ids = rs + jnp.arange(c, dtype=jnp.int32)
+
+        def merge_block(best, blk):
+            best_d, best_i = best
+            xb, bs = blk
+            col_ids = bs + jnp.arange(b, dtype=jnp.int32)
+            dmat = pairwise(metric, xq, xb)  # [c, b]
+            invalid = (row_ids[:, None] == col_ids[None, :]) | (col_ids[None, :] >= n)
+            dmat = jnp.where(invalid, jnp.inf, dmat)
+            cat_d = jnp.concatenate([best_d, dmat], axis=1)
+            cat_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(col_ids[None, :], (c, b))], axis=1)
+            new_d, sel = _topk_smallest(cat_d, k)
+            return (new_d, jnp.take_along_axis(cat_i, sel, axis=1)), None
+
+        init = (jnp.full((c, k), jnp.inf, x.dtype),
+                jnp.zeros((c, k), jnp.int32))
+        (best_d, best_i), _ = lax.scan(merge_block, init, (xcols, bstarts))
+        return best_d, best_i
+
+    dist, idx = lax.map(one_chunk, (xrows, rstarts))
+    return (idx.reshape(-1, k)[:n].astype(jnp.int32),
+            dist.reshape(-1, k)[:n])
+
+
+def _window_candidates(perm: jnp.ndarray, k: int, n: int) -> jnp.ndarray:
+    """For each point, the k predecessors + k successors along a sort order.
+
+    Mirrors the reference's ±k candidate window over the Z-order sorted
+    sequence (``TsneHelpers.scala:146-156``).  Returns [n, 2k] candidate ids in
+    *original point order*; missing slots (sequence edges) carry sentinel ``n``.
+    """
+    sentinel = jnp.full((k,), n, dtype=perm.dtype)
+    padded = jnp.concatenate([sentinel, perm.astype(jnp.int32), sentinel])
+    offs = jnp.concatenate([jnp.arange(k), jnp.arange(k + 1, 2 * k + 1)]).astype(jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)[:, None] + offs[None, :]
+    win = padded[pos]  # [n, 2k] neighbors of sorted position i
+    out = jnp.zeros((n, 2 * k), jnp.int32).at[perm].set(win)
+    return out
+
+
+def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
+                rounds: int = 3, key: jax.Array | None = None,
+                *, proj_dims: int = 3, rerank_budget: int = 1 << 27):
+    """Approximate kNN via random-shift Z-order rounds + exact re-rank.
+
+    Reference ``projectKnn`` (``TsneHelpers.scala:93-160``): 1 unshifted round +
+    (rounds-1) rounds shifted by a random vector, Z-order sort, ±k window
+    candidates, union, dedup, exact-metric top-k.
+
+    TPU redesign: for dim > 3 the Z-order runs over a random Gaussian projection
+    to ``proj_dims`` dims (the reference's full-dim lazy comparator has no
+    array-key equivalent; locality is preserved in the JL sense and the exact
+    re-rank below makes the final distances exact either way).  Shifts are drawn
+    per-dimension as U[0,1) *fractions of the data span* — scale-free, unlike
+    the reference's absolute U[0,1) shift (``TsneHelpers.scala:97-99``) which
+    silently degrades on data whose scale is far from 1.
+    """
+    n, dim = x.shape
+    k = _clamp_k(k, n)
+    if key is None:
+        key = jax.random.key(0)
+
+    m = min(dim, proj_dims)
+
+    def round_coords(it: int, key):
+        if dim > m:
+            # fresh random projection each round: unlike a shift, a new
+            # projection changes WHICH structure the Z-curve can see, so
+            # rounds contribute far more diverse candidates in high dim
+            pkey, skey = jax.random.split(key)
+            r = jax.random.normal(pkey, (dim, m), x.dtype) / jnp.sqrt(
+                jnp.asarray(dim, x.dtype))
+            z = x @ r
+        else:
+            z = x
+            skey = key
+        if it > 0:  # first round unshifted, as TsneHelpers.scala:105
+            span = jnp.max(z, axis=0) - jnp.min(z, axis=0)
+            z = z + jax.random.uniform(skey, (m,), z.dtype) * span
+        return z
+
+    cands = []
+    for it in range(max(1, rounds)):
+        key, rkey = jax.random.split(key)
+        z = round_coords(it, rkey)
+        cands.append(_window_candidates(zorder_permutation(z), k, n))
+    cand = jnp.concatenate(cands, axis=1)  # [n, 2k*rounds]
+
+    # dedup per row: sort ids, mark repeats with the sentinel
+    cand = jnp.sort(cand, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((n, 1), bool), cand[:, 1:] == cand[:, :-1]], axis=1)
+    cand = jnp.where(dup, n, cand)
+
+    # exact re-rank (row-chunked so [rows, C, dim] stays within budget)
+    cwidth = cand.shape[1]
+    f = metric_fn(metric)
+    rows = int(min(n, max(1, rerank_budget // max(1, cwidth * dim))))
+    nchunks = math.ceil(n / rows)
+    xpad = jnp.pad(x, ((0, nchunks * rows - n), (0, 0)))
+    cpad = jnp.pad(cand, ((0, nchunks * rows - n), (0, 0)), constant_values=n)
+
+    def rerank(args):
+        xc, cc = args
+        xn = x[jnp.minimum(cc, n - 1)]            # [rows, C, dim]
+        d = f(xc[:, None, :], xn)                 # exact metric, parity with :126
+        d = jnp.where(cc == n, jnp.inf, d)
+        dd, sel = _topk_smallest(d, k)
+        return dd, jnp.take_along_axis(cc, sel, axis=1)
+
+    dist, idx = lax.map(rerank, (xpad.reshape(nchunks, rows, dim),
+                                 cpad.reshape(nchunks, rows, cwidth)))
+    return (idx.reshape(-1, k)[:n].astype(jnp.int32),
+            dist.reshape(-1, k)[:n])
+
+
+def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
+        *, blocks: int = 8, rounds: int = 3, key: jax.Array | None = None):
+    """Dispatch mirroring ``Tsne.scala:74-79``."""
+    if method == "bruteforce":
+        return knn_bruteforce(x, k, metric)
+    if method == "partition":
+        return knn_partition(x, k, metric, blocks)
+    if method == "project":
+        return knn_project(x, k, metric, rounds, key)
+    raise ValueError(f"Knn method '{method}' not defined")

@@ -1,0 +1,1 @@
+"""SPMD layer: device mesh, shardings, multi-host init, padded point sharding."""

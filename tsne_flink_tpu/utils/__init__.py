@@ -1,0 +1,1 @@
+"""Host-side utilities: COO CSV I/O, CLI, execution-plan dump, checkpointing."""
