@@ -1,0 +1,83 @@
+"""Headline benchmark: MNIST-60k-scale embedding wall-clock on real TPU.
+
+Prints ONE JSON line:
+  {"metric": "mnist60k_embed_seconds", "value": <s>, "unit": "s", "vs_baseline": <x>}
+
+Baseline (BASELINE.md): the reference publishes NO numbers; the north-star
+target is "embed MNIST-60k in < 10 s on a TPU v5e-8".  vs_baseline is
+10.0 / measured_seconds (>= 1.0 means the target is met *on however many chips
+are actually present* — here usually ONE v5e chip, i.e. an 8x handicap).
+
+The workload mirrors BASELINE.json config 2 ("MNIST-60k, knnMethod=project,
+theta=0.5, perplexity=30"): 60k points x 784 dims (synthetic MNIST-like blobs
+— the image has no network egress to fetch the real ultrasparse file; identical
+shapes/flops), project-kNN, beta search, symmetrization, 300 optimization
+iterations.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_data(n=60_000, d=784, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.random((classes, d)).astype(np.float32)
+    labels = rng.integers(0, classes, n)
+    x = centers[labels] + 0.15 * rng.standard_normal((n, d)).astype(np.float32)
+    return np.clip(x, 0.0, 1.0)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
+    from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+    from tsne_flink_tpu.ops.knn import knn_project
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 300
+    x_np = make_data(n)
+
+    cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=0.5,
+                     repulsion="exact", row_chunk=4096)
+    k = 90  # 3 * perplexity (Tsne.scala:55)
+
+    x = jnp.asarray(x_np)
+    t0 = time.time()
+    idx, dist = jax.jit(
+        lambda xx: knn_project(xx, k, rounds=3, key=jax.random.key(0)))(x)
+    idx.block_until_ready()
+    t_knn = time.time() - t0
+
+    t1 = time.time()
+    p_cond = pairwise_affinities(dist, cfg.perplexity)
+    jidx, jval = joint_distribution(idx, p_cond)
+    jval.block_until_ready()
+    t_aff = time.time() - t1
+
+    state = init_working_set(jax.random.key(0), n, 2, jnp.float32)
+    runner = ShardedOptimizer(cfg, n)
+    t2 = time.time()
+    state, losses = runner(state, jidx, jval)
+    state.y.block_until_ready()
+    t_opt = time.time() - t2
+
+    total = time.time() - t0
+    print(f"# knn={t_knn:.2f}s affinities={t_aff:.2f}s optimize={t_opt:.2f}s "
+          f"({iters} iters, {jax.device_count()} {jax.default_backend()} "
+          f"device(s)), final KL={float(losses[-1]):.4f}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "mnist60k_embed_seconds",
+        "value": round(total, 3),
+        "unit": "s",
+        "vs_baseline": round(10.0 / total, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,6 @@ against the float64 NumPy oracle are meaningful.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: never run unit tests on the TPU chip
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,4 +16,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The container's sitecustomize imports jax and registers the TPU PJRT plugin
+# before conftest runs, so the JAX_PLATFORMS env var is already latched — the
+# config update is the only reliable way to pin tests to the CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+if jax.default_backend() != "cpu" or len(jax.devices()) != 8:
+    raise RuntimeError(
+        "tsne_flink_tpu tests need an 8-device CPU mesh; got "
+        f"{len(jax.devices())} {jax.default_backend()} device(s). Unset any "
+        "conflicting --xla_force_host_platform_device_count in XLA_FLAGS.")

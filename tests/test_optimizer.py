@@ -1,0 +1,154 @@
+"""Optimizer tests — analogs of the reference's gradient (theta=0 oracle,
+TsneHelpersTestSuite.scala:168-209), updateEmbedding incl. golden gains
+(:233-271), initWorkingSet invariants (:211-231) and iterationComputation
+end-to-end superstep tests (:273-327), plus full-trajectory goldens the
+reference never had."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import oracle
+from tsne_flink_tpu.models.tsne import (
+    TsneConfig, TsneState, init_working_set, optimize, tsne_embed,
+)
+from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+from tsne_flink_tpu.ops.knn import knn_bruteforce
+from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
+
+
+def problem(n=30, d=6, seed=0, k=8, perplexity=4.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, d)) * 4.0
+    x = centers[rng.integers(0, 3, n)] + rng.normal(size=(n, d))
+    idx, dist = knn_bruteforce(jnp.asarray(x), k)
+    p = pairwise_affinities(dist, perplexity)
+    jidx, jval = joint_distribution(idx, p)
+    pm = oracle.joint_dense(np.asarray(idx), np.asarray(p))
+    y0 = rng.normal(size=(n, 2)) * 1e-4
+    return x, jidx, jval, pm, y0
+
+
+def test_init_working_set_invariants():
+    st = init_working_set(jax.random.key(0), 100, 3, jnp.float64)
+    assert st.y.shape == (100, 3)
+    np.testing.assert_array_equal(np.asarray(st.update), 0.0)
+    np.testing.assert_array_equal(np.asarray(st.gains), 1.0)
+    assert np.abs(np.asarray(st.y)).max() < 1e-2  # N(0, 1e-4) scale
+    # the seed must actually seed (fixes the reference's unused randomState)
+    st2 = init_working_set(jax.random.key(0), 100, 3, jnp.float64)
+    np.testing.assert_array_equal(np.asarray(st.y), np.asarray(st2.y))
+    st3 = init_working_set(jax.random.key(1), 100, 3, jnp.float64)
+    assert np.abs(np.asarray(st.y) - np.asarray(st3.y)).max() > 0
+
+
+def test_exact_repulsion_matches_oracle():
+    rng = np.random.default_rng(1)
+    y = rng.normal(size=(25, 2))
+    rep, sumq = exact_repulsion(jnp.asarray(y), row_chunk=7)
+    n = len(y)
+    q = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                q[i, j] = 1.0 / (1.0 + oracle.dist(y[i], y[j], "sqeuclidean"))
+    want_rep = np.stack([(q[i] ** 2)[:, None].T @ (y[i] - y) for i in range(n)]
+                        ).reshape(n, 2)
+    np.testing.assert_allclose(float(sumq), q.sum(), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(rep), want_rep, atol=1e-9)
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean"])
+def test_single_iteration_matches_oracle(metric):
+    x, jidx, jval, pm, y0 = problem()
+    cfg = TsneConfig(iterations=1, metric=metric, repulsion="exact")
+    st = TsneState(y=jnp.asarray(y0), update=jnp.zeros_like(jnp.asarray(y0)),
+                   gains=jnp.ones_like(jnp.asarray(y0)))
+    got, _ = optimize(st, jidx, jval, cfg)
+    want_y, _ = oracle.run(pm, y0, 1, metric=metric)
+    np.testing.assert_allclose(np.asarray(got.y), want_y, atol=1e-9)
+
+
+def test_short_trajectory_and_loss_match_oracle():
+    # NOTE: t-SNE dynamics at lr=1000 + exaggeration are chaotic — a measured
+    # 7e-18 single-step roundoff difference amplifies ~6x per iteration, which
+    # is why the reference's own suite goldens only ONE superstep
+    # (TsneHelpersTestSuite.scala:273-327).  10 iterations keeps amplification
+    # below 1e-8 while still exercising the loop, gains memory and loss slots.
+    x, jidx, jval, pm, y0 = problem(n=25, k=6)
+    iters = 10
+    cfg = TsneConfig(iterations=iters, repulsion="exact")
+    st = TsneState(y=jnp.asarray(y0), update=jnp.zeros_like(jnp.asarray(y0)),
+                   gains=jnp.ones_like(jnp.asarray(y0)))
+    got, losses = optimize(st, jidx, jval, cfg)
+    want_y, want_losses = oracle.run(pm, y0, iters)
+    np.testing.assert_allclose(np.asarray(got.y), want_y, atol=1e-8)
+    assert np.asarray(losses).shape == (1,)
+    np.testing.assert_allclose(np.asarray(losses)[0], want_losses[10],
+                               rtol=1e-9)
+    # embedding stays centered (centerEmbedding every iteration)
+    np.testing.assert_allclose(np.asarray(got.y).mean(axis=0), 0.0, atol=1e-9)
+
+
+def test_long_run_structural_invariants():
+    # what survives chaos after 120 iterations: finite, centered, loss sane
+    x, jidx, jval, pm, y0 = problem(n=25, k=6)
+    cfg = TsneConfig(iterations=120, repulsion="exact")
+    st = TsneState(y=jnp.asarray(y0), update=jnp.zeros_like(jnp.asarray(y0)),
+                   gains=jnp.ones_like(jnp.asarray(y0)))
+    got, losses = optimize(st, jidx, jval, cfg)
+    y = np.asarray(got.y)
+    assert np.isfinite(y).all()
+    np.testing.assert_allclose(y.mean(axis=0), 0.0, atol=1e-8)
+    assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_gains_update_rule():
+    # golden gains: x0.8 on same sign, +0.2 on flip, floored at 0.01
+    # (TsneHelpers.scala:357-362)
+    from tsne_flink_tpu.models.tsne import _update_embedding
+    st = TsneState(y=jnp.zeros((2, 2)),
+                   update=jnp.asarray([[1.0, -1.0], [0.0, 0.005]]),
+                   gains=jnp.asarray([[1.0, 1.0], [0.01, 0.01]]))
+    grad = jnp.asarray([[2.0, 3.0], [-4.0, 0.004]])
+    cfg = TsneConfig()
+    new = _update_embedding(st, grad, 0.5, cfg)
+    # [1,0]: prev=0.0 and grad<0 agree on ">0 == False" -> same sign -> x0.8,
+    # floored at 0.01 (the reference compares ">0" booleans, not signum)
+    np.testing.assert_allclose(np.asarray(new.gains),
+                               [[0.8, 1.2], [0.01, 0.01]])
+    # update = momentum*prev - lr*gain*grad; y += update
+    want_upd = 0.5 * np.asarray(st.update) - 1000.0 * np.asarray(
+        new.gains) * np.asarray(grad)
+    np.testing.assert_allclose(np.asarray(new.update), want_upd, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(new.y), want_upd, atol=1e-12)
+
+
+def test_three_phase_schedule_boundaries():
+    # 22 iters crosses the momentum switch at iteration 20; the oracle
+    # implements the reference's 3-phase schedule independently.  Chaotic
+    # roundoff amplification on this fixture is ~4x/iter (measured: 6e-6 by
+    # iter 15), so 1e-3 at iter 22 is tight in that regime — whereas a WRONG
+    # momentum (0.5 vs 0.8 after the switch) perturbs the trajectory at O(1).
+    x, jidx, jval, pm, y0 = problem(n=20, k=5)
+    cfg = TsneConfig(iterations=22, repulsion="exact")
+    st = TsneState(y=jnp.asarray(y0), update=jnp.zeros_like(jnp.asarray(y0)),
+                   gains=jnp.ones_like(jnp.asarray(y0)))
+    got, _ = optimize(st, jidx, jval, cfg)
+    want_y, _ = oracle.run(pm, y0, 22)
+    np.testing.assert_allclose(np.asarray(got.y), want_y,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_tsne_embed_end_to_end_kl_decreases():
+    rng = np.random.default_rng(5)
+    centers = rng.normal(size=(3, 10)) * 6.0
+    x = centers[rng.integers(0, 3, 90)] + rng.normal(size=(90, 10))
+    cfg = TsneConfig(iterations=150, perplexity=10.0, repulsion="exact")
+    y, losses = tsne_embed(jnp.asarray(x), cfg, neighbors=30, seed=3)
+    losses = np.asarray(losses)
+    assert np.isfinite(losses).all()
+    # KL under plain P (post-exaggeration slots) must improve over time
+    assert losses[-1] < losses[10 + 1]  # slot 11 ~ iter 120, after switch at 101
+    assert np.isfinite(np.asarray(y)).all()

@@ -133,13 +133,16 @@ def _attractive_forces(y_local, y_full, jidx, jval, metric, exag, z,
 
 
 def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
-              axis_name=None, row_offset=0, valid=None):
-    """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317)."""
+              axis_name=None, row_offset=0, valid_full=None):
+    """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317).
+
+    ``valid_full`` is the GLOBAL point-validity mask (already gathered once,
+    outside the iteration loop — it is loop-invariant)."""
     y_full = (y_local if axis_name is None
               else lax.all_gather(y_local, axis_name, tiled=True))
     if cfg.repulsion == "exact":
         rep, sq = exact_repulsion(y_local, y_full, row_offset=row_offset,
-                                  col_valid=valid, row_chunk=cfg.row_chunk)
+                                  col_valid=valid_full, row_chunk=cfg.row_chunk)
     else:
         raise NotImplementedError(
             f"repulsion='{cfg.repulsion}' lands in a later milestone")
@@ -184,6 +187,10 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
     alpha = jnp.asarray(cfg.early_exaggeration, state.y.dtype)
     one = jnp.ones((), state.y.dtype)
     n_slots = max(cfg.n_loss_slots, 1)
+    # the validity mask is loop-invariant: gather it to global form ONCE here,
+    # not inside the fori_loop (XLA does not hoist collectives out of loops)
+    valid_full = (valid if axis_name is None or valid is None
+                  else lax.all_gather(valid, axis_name, tiled=True))
 
     def body(i, carry):
         st, loss_arr = carry
@@ -191,7 +198,7 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
         exag = jnp.where(i < cfg.exaggeration_end, alpha, one)
         grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
                                axis_name=axis_name, row_offset=row_offset,
-                               valid=valid)
+                               valid_full=valid_full)
         if valid is not None:
             grad = grad * valid[:, None].astype(grad.dtype)
         st = _update_embedding(st, grad, momentum, cfg)

@@ -90,16 +90,20 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
 
     Input: kNN structure ``idx`` [N, k] (int32) and conditional affinities
     ``p`` [N, k] (entries with p == 0 are treated as absent).  Output:
-    ``(jidx, jval)`` both [N, S] (S = ``sym_width`` or 2k), rows sorted by
-    neighbor id, padded with (idx=0, val=0.0).  Valid entries carry
-    val >= 1e-12, so ``jval > 0`` is the validity mask.
+    ``(jidx, jval)`` both [N, S], rows sorted by neighbor id, padded with
+    (idx=0, val=0.0).  Valid entries carry val >= 1e-12, so ``jval > 0`` is
+    the validity mask.
 
-    Should a row overflow S distinct neighbors (possible for hub points whose
-    in-degree exceeds k), the largest-id entries are dropped; the normalizer
-    uses the kept entries so that ΣP == 1 holds exactly either way.
+    With ``sym_width=None`` (the default) S is sized to the actual maximum
+    symmetrized row degree (out-degree k plus in-degree of the point's hub-ness),
+    rounded up to a lane-friendly multiple of 8 — no truncation, exactly the
+    reference's irregular sparse rows made regular.  Sizing is data-dependent,
+    so the default only works OUTSIDE jit (it is preprocessing); under jit pass
+    an explicit ``sym_width``.  If an explicit width is exceeded, the
+    largest-id entries of the overflowing row are dropped and the normalizer
+    uses the kept entries so ΣP == 1 still holds exactly.
     """
     n, k = idx.shape
-    s = int(sym_width) if sym_width is not None else 2 * k
     dtype = p.dtype
 
     rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k))
@@ -127,6 +131,13 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
     row_first = jnp.concatenate([jnp.ones((1,), bool), ii[1:] != ii[:-1]])
     row_start_run = lax.cummax(jnp.where(row_first, run, 0))
     col = run - row_start_run
+
+    if sym_width is not None:
+        s = int(sym_width)
+    else:
+        # size to the true max row degree (concrete -> host sync; preprocessing)
+        max_deg = int(jnp.max(jnp.where(first & (ii < n), col, -1))) + 1
+        s = max(8, -(-max_deg // 8) * 8)
 
     keep = first & (col < s) & (ii < n)
     scat_row = jnp.where(keep, ii, n)  # dump row n

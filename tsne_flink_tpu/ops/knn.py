@@ -127,8 +127,9 @@ def _window_candidates(perm: jnp.ndarray, k: int, n: int) -> jnp.ndarray:
     sequence (``TsneHelpers.scala:146-156``).  Returns [n, 2k] candidate ids in
     *original point order*; missing slots (sequence edges) carry sentinel ``n``.
     """
-    sentinel = jnp.full((k,), n, dtype=perm.dtype)
-    padded = jnp.concatenate([sentinel, perm.astype(jnp.int32), sentinel])
+    perm = perm.astype(jnp.int32)
+    sentinel = jnp.full((k,), n, dtype=jnp.int32)
+    padded = jnp.concatenate([sentinel, perm, sentinel])
     offs = jnp.concatenate([jnp.arange(k), jnp.arange(k + 1, 2 * k + 1)]).astype(jnp.int32)
     pos = jnp.arange(n, dtype=jnp.int32)[:, None] + offs[None, :]
     win = padded[pos]  # [n, 2k] neighbors of sorted position i

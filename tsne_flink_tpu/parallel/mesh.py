@@ -1,0 +1,122 @@
+"""Point-axis SPMD: mesh construction, padding, and the sharded optimize runner.
+
+The reference scales by hash-sharding the point axis across Flink task
+managers, with broadcast joins for global state (SURVEY §2.2).  The TPU
+equivalent is a 1-D device mesh over the ``points`` axis:
+
+* every per-point array — Y, update, gains, the padded P rows (jidx, jval) —
+  is sharded on axis 0 via ``shard_map``;
+* the reference's full-embedding broadcast (``TsneHelpers.scala:277-278``, its
+  memory wall) becomes one ``lax.all_gather`` of the tiny [N, m] embedding over
+  ICI per iteration;
+* Flink's global reduces (Z, ΣP, mean, loss — SURVEY §2.2) become ``lax.psum``.
+
+N is padded to a multiple of the mesh size; padded points carry a ``valid=False``
+mask that removes them from Z, the loss, and the centering statistics.
+
+Multi-host: :func:`distributed_init` wraps ``jax.distributed.initialize`` —
+the DCN analog of the reference's Akka/Netty runtime bring-up.  The same
+``shard_map`` program then runs over the global mesh, with XLA routing
+collectives over ICI within a slice and DCN across hosts.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+
+AXIS = "points"
+
+
+def distributed_init(coordinator: str | None = None, num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Multi-host bring-up (jax.distributed.initialize); no-op single-host."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator, num_processes, process_id)
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (AXIS,))
+
+
+def pad_rows(a: jnp.ndarray, n_pad: int, fill=0):
+    if n_pad == 0:
+        return a
+    widths = [(0, n_pad)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, widths, constant_values=fill)
+
+
+class ShardedOptimizer:
+    """Callable running :func:`tsne_flink_tpu.models.tsne.optimize` under
+    shard_map on a 1-D point mesh.  With one device it degrades to plain jit
+    of the identical program."""
+
+    def __init__(self, cfg: TsneConfig, n: int, n_devices: int | None = None):
+        self.cfg = cfg
+        self.n = n
+        self.mesh = make_mesh(n_devices)
+        self.n_devices = self.mesh.devices.size
+        d = self.n_devices
+        self.n_padded = math.ceil(n / d) * d
+        self.n_local = self.n_padded // d
+
+        if d == 1:
+            self._fn = jax.jit(partial(optimize, cfg=cfg))
+            return
+
+        cfg_ = cfg
+        n_local = self.n_local
+
+        def local_run(state, jidx, jval, valid):
+            row_offset = lax.axis_index(AXIS) * n_local
+            return optimize(state, jidx, jval, cfg_, axis_name=AXIS,
+                            row_offset=row_offset, valid=valid)
+
+        pspec = P(AXIS)
+        state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
+        self._fn = jax.jit(
+            jax.shard_map(
+                local_run, mesh=self.mesh,
+                in_specs=(state_spec, pspec, pspec, pspec),
+                out_specs=(state_spec, P()),  # loss trace is psum-replicated
+            ))
+
+    def _pad_inputs(self, state: TsneState, jidx, jval):
+        npad = self.n_padded - self.n
+        state = TsneState(y=pad_rows(state.y, npad),
+                          update=pad_rows(state.update, npad),
+                          gains=pad_rows(state.gains, npad, fill=1.0))
+        jidx = pad_rows(jidx, npad)
+        jval = pad_rows(jval, npad)
+        valid = jnp.arange(self.n_padded) < self.n
+        return state, jidx, jval, valid
+
+    def lower(self, state, jidx, jval):
+        if self.n_devices == 1:
+            return self._fn.lower(state, jidx, jval)
+        return self._fn.lower(*self._pad_inputs(state, jidx, jval))
+
+    def __call__(self, state: TsneState, jidx, jval):
+        if self.n_devices == 1:
+            return self._fn(state, jidx, jval)
+        state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
+        out_state, losses = self._fn(state, jidx, jval, valid)
+        return TsneState(y=out_state.y[: self.n],
+                         update=out_state.update[: self.n],
+                         gains=out_state.gains[: self.n]), losses
+
+
+def shard_pipeline(cfg: TsneConfig, n: int,
+                   n_devices: int | None = None) -> ShardedOptimizer:
+    return ShardedOptimizer(cfg, n, n_devices)

@@ -1,0 +1,158 @@
+"""CLI driver — flag-for-flag parity with the reference's ParameterTool surface
+(Tsne.scala:39-63; documented in reference README.md:13-38), plus TPU-native
+extensions (sharding, repulsion backend, checkpointing, HLO dump).
+
+Known reference quirks resolved here (SURVEY §5):
+* ``--loss`` vs README's ``--lossFile``: both accepted, same destination.
+* ``--randomState`` actually seeds (the reference read it and ignored it).
+* ``--executionPlan`` dumps the compiled program (jaxpr + StableHLO) instead of
+  executing — the analog of Flink's execution-plan JSON (Tsne.scala:89-94).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tsne-tpu",
+        description="TPU-native Barnes-Hut t-SNE (JAX/XLA)")
+    # --- reference-parity flags (names, defaults: Tsne.scala:39-63) ---
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--dimension", type=int, required=True)
+    p.add_argument("--knnMethod", required=True,
+                   choices=["bruteforce", "partition", "project"])
+    p.add_argument("--inputDistanceMatrix", action="store_true")
+    p.add_argument("--executionPlan", action="store_true")
+    p.add_argument("--metric", default="sqeuclidean",
+                   choices=["sqeuclidean", "euclidean", "cosine"])
+    p.add_argument("--perplexity", type=float, default=30.0)
+    p.add_argument("--nComponents", type=int, default=2)
+    p.add_argument("--earlyExaggeration", type=float, default=4.0)
+    p.add_argument("--learningRate", type=float, default=1000.0)
+    p.add_argument("--iterations", type=int, default=300)
+    p.add_argument("--randomState", type=int, default=0)
+    p.add_argument("--neighbors", type=int, default=None,
+                   help="default: 3 * perplexity (Tsne.scala:55)")
+    p.add_argument("--initialMomentum", type=float, default=0.5)
+    p.add_argument("--finalMomentum", type=float, default=0.8)
+    p.add_argument("--theta", type=float, default=0.25)
+    p.add_argument("--loss", "--lossFile", dest="loss", default="loss.txt")
+    p.add_argument("--knnIterations", type=int, default=3)
+    p.add_argument("--knnBlocks", type=int, default=None,
+                   help="default: number of devices (Tsne.scala:63)")
+    # --- TPU-native extensions ---
+    p.add_argument("--repulsion", default="auto",
+                   choices=["auto", "exact", "bh", "fft"],
+                   help="auto: exact when theta==0 or N small, else bh/fft")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64", "bfloat16"])
+    p.add_argument("--devices", type=int, default=None,
+                   help="mesh size over the point axis (default: all)")
+    p.add_argument("--checkpoint", default=None,
+                   help="path prefix for periodic (y, update, gains, iter) "
+                        "checkpoints — capability-add over the reference")
+    p.add_argument("--checkpointEvery", type=int, default=0)
+    p.add_argument("--resume", default=None)
+    p.add_argument("--profile", default=None,
+                   help="jax.profiler trace directory")
+    return p
+
+
+def pick_repulsion(mode: str, theta: float, n: int) -> str:
+    if mode != "auto":
+        return mode
+    if theta == 0.0 or n <= 32768:
+        return "exact"
+    return "bh"
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set, optimize
+    from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+    from tsne_flink_tpu.ops.knn import knn as knn_dispatch
+    from tsne_flink_tpu.utils import io as tio
+    from tsne_flink_tpu.parallel.mesh import shard_pipeline
+
+    t0 = time.time()
+    dtype = jnp.dtype(args.dtype)
+    neighbors = (args.neighbors if args.neighbors is not None
+                 else 3 * int(args.perplexity))
+
+    if args.inputDistanceMatrix:
+        ids, idx, dist = tio.read_distance_matrix(args.input)
+        idx = jnp.asarray(idx)
+        dist = jnp.asarray(dist, dtype)
+        n = len(ids)
+    else:
+        ids, x_np = tio.read_input(args.input, args.dimension)
+        n = len(ids)
+        x = jnp.asarray(x_np, dtype)
+        key = jax.random.key(args.randomState)
+        idx, dist = jax.jit(
+            lambda xx: knn_dispatch(
+                xx, neighbors, args.knnMethod, args.metric,
+                blocks=args.knnBlocks or jax.device_count(),
+                rounds=args.knnIterations, key=key))(x)
+
+    cfg = TsneConfig(
+        n_components=args.nComponents,
+        perplexity=args.perplexity,
+        early_exaggeration=args.earlyExaggeration,
+        learning_rate=args.learningRate,
+        iterations=args.iterations,
+        initial_momentum=args.initialMomentum,
+        final_momentum=args.finalMomentum,
+        theta=args.theta,
+        metric=args.metric,
+        repulsion=pick_repulsion(args.repulsion, args.theta, n),
+    )
+
+    p_cond = pairwise_affinities(dist, cfg.perplexity)
+    jidx, jval = joint_distribution(idx, p_cond)
+    state = init_working_set(jax.random.key(args.randomState), n,
+                             cfg.n_components, dtype)
+
+    runner = shard_pipeline(cfg, n, n_devices=args.devices)
+
+    if args.executionPlan:
+        lowered = runner.lower(state, jidx, jval)
+        plan = {
+            "program": "tsne_optimize",
+            "backend": jax.default_backend(),
+            "devices": runner.n_devices,
+            "jaxpr": str(lowered.jaxpr) if hasattr(lowered, "jaxpr") else None,
+            "stablehlo": lowered.as_text(),
+        }
+        with open("tsne_executionPlan.json", "w") as f:
+            json.dump(plan, f)
+        print("execution plan written to tsne_executionPlan.json")
+        return 0
+
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+    state, losses = runner(state, jidx, jval)
+    state.y.block_until_ready()
+    if args.profile:
+        jax.profiler.stop_trace()
+
+    tio.write_embedding(args.output, ids, np.asarray(state.y[:n]))
+    tio.write_loss(args.loss, np.asarray(losses))
+    print(f"embedded {n} points -> {args.output} "
+          f"({time.time() - t0:.2f}s total, backend={jax.default_backend()})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

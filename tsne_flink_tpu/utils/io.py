@@ -1,0 +1,78 @@
+"""Host-side COO CSV ingest and output, mirroring the reference's formats.
+
+* :func:`read_input` — ``Tsne.readInput`` (Tsne.scala:138-153): CSV rows
+  ``point_id,feature_id,value`` assembled into dense per-point vectors.  Point
+  ids need not be contiguous (the reference keeps them opaque through the
+  dataflow); we map them to positions and carry the original ids to the output.
+* :func:`read_distance_matrix` — ``Tsne.readDistanceMatrix`` (Tsne.scala:155-159):
+  CSV rows ``i,j,distance`` used directly as the (possibly precomputed-kNN)
+  neighbor stream; assembled into the padded ``[N, K]`` (idx, dist) layout with
+  +inf padding.
+* :func:`write_embedding` — the output writer.  NOTE: the reference truncates
+  to the first TWO components regardless of ``--nComponents`` (Tsne.scala:86,
+  SURVEY §7 "faithfulness decisions"); we write all components.
+* :func:`write_loss` — the loss-trace dump (Tsne.scala:99-101); one
+  ``iteration,loss`` line per recorded slot instead of a Java HashMap toString.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _load_coo(path: str) -> np.ndarray:
+    return np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
+
+
+def read_input(path: str, dimension: int):
+    """COO (point, feature, value) CSV -> (ids [N], dense X [N, dimension])."""
+    coo = _load_coo(path)
+    pts = coo[:, 0].astype(np.int64)
+    feats = coo[:, 1].astype(np.int64)
+    if feats.max() >= dimension:
+        raise ValueError(
+            f"feature id {feats.max()} out of range for --dimension {dimension}")
+    ids, pos = np.unique(pts, return_inverse=True)
+    x = np.zeros((len(ids), dimension), np.float64)
+    x[pos, feats] = coo[:, 2]
+    return ids, x
+
+
+def read_distance_matrix(path: str):
+    """COO (i, j, distance) CSV -> (ids [N], idx [N, K], dist [N, K]).
+
+    K is the max row length; shorter rows are padded with dist = +inf (masked
+    downstream exactly like approximate-kNN padding).
+    """
+    coo = _load_coo(path)
+    ii = coo[:, 0].astype(np.int64)
+    jj = coo[:, 1].astype(np.int64)
+    ids, ipos = np.unique(np.concatenate([ii, jj]), return_inverse=True)
+    n = len(ids)
+    ipos_i = ipos[: len(ii)]
+    ipos_j = ipos[len(ii):]
+    order = np.lexsort((coo[:, 2], ipos_i))  # by row, then ascending distance
+    ipos_i, ipos_j, vals = ipos_i[order], ipos_j[order], coo[:, 2][order]
+    counts = np.bincount(ipos_i, minlength=n)
+    k = int(counts.max())
+    idx = np.zeros((n, k), np.int32)
+    dist = np.full((n, k), np.inf, np.float64)
+    slot = np.arange(len(ipos_i)) - np.repeat(
+        np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+    idx[ipos_i, slot] = ipos_j
+    dist[ipos_i, slot] = vals
+    return ids, idx, dist
+
+
+def write_embedding(path: str, ids: np.ndarray, y: np.ndarray) -> None:
+    n, m = y.shape
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(str(int(ids[i])) + "," +
+                    ",".join(repr(float(v)) for v in y[i]) + "\n")
+
+
+def write_loss(path: str, losses: np.ndarray, every: int = 10) -> None:
+    with open(path, "w") as f:
+        for t, v in enumerate(np.asarray(losses)):
+            f.write(f"{(t + 1) * every},{float(v)!r}\n")
