@@ -35,16 +35,17 @@ def main():
     import jax.numpy as jnp
 
     from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
-    from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+    from tsne_flink_tpu.ops.affinities import affinity_pipeline
     from tsne_flink_tpu.ops.knn import knn_project
     from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 300
+    repulsion = sys.argv[3] if len(sys.argv) > 3 else "fft"
     x_np = make_data(n)
 
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=0.5,
-                     repulsion="exact", row_chunk=4096)
+                     repulsion=repulsion, row_chunk=4096)
     k = 90  # 3 * perplexity (Tsne.scala:55)
 
     x = jnp.asarray(x_np)
@@ -55,8 +56,7 @@ def main():
     t_knn = time.time() - t0
 
     t1 = time.time()
-    p_cond = pairwise_affinities(dist, cfg.perplexity)
-    jidx, jval = joint_distribution(idx, p_cond)
+    jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
     jval.block_until_ready()
     t_aff = time.time() - t1
 

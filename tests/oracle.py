@@ -144,3 +144,81 @@ def run(pm, y0, iterations, metric="sqeuclidean", lr=1000.0,
             losses[i + 1] = loss
         y, upd, gains = update(y, upd, gains, grad, momentum, lr)
     return y, losses
+
+
+class _QT:
+    """Pointer quadtree with the reference's exact semantics: capacity-1
+    leaves, center-of-mass accumulation on insert, and the squared-distance
+    acceptance gate (QuadTree.scala:38-152)."""
+
+    def __init__(self, cx, cy, half):
+        self.cx, self.cy, self.half = cx, cy, half
+        self.kids = None
+        self.n = 0
+        self.sum = np.zeros(2)
+        self.point = None
+
+    def contains(self, p):
+        return (self.cx - self.half <= p[0] <= self.cx + self.half
+                and self.cy - self.half <= p[1] <= self.cy + self.half)
+
+    def insert(self, p):
+        if not self.contains(p):
+            return False
+        self.sum += p
+        self.n += 1
+        if self.kids is None and self.point is None:
+            self.point = p.copy()
+            return True
+        if self.kids is None:
+            if np.array_equal(self.point, p):
+                return True
+            h = self.half / 2
+            self.kids = [_QT(self.cx - h, self.cy + h, h),
+                         _QT(self.cx + h, self.cy + h, h),
+                         _QT(self.cx - h, self.cy - h, h),
+                         _QT(self.cx + h, self.cy - h, h)]
+            old = self.point
+            self.point = None
+            for k in self.kids:
+                if k.insert(old):
+                    break
+        for k in self.kids:
+            if k.insert(p):
+                return True
+        return False
+
+    def repulse(self, p, theta):
+        if self.n == 0 or (self.kids is None and self.point is not None
+                           and np.array_equal(self.point, p)):
+            return np.zeros(2), 0.0
+        com = self.sum / self.n
+        d = p - com
+        dsq = float(d @ d)
+        if self.kids is None or (self.half / dsq < theta):
+            q = 1.0 / (1.0 + dsq)
+            mult = self.n * q
+            return mult * q * d, mult
+        f = np.zeros(2)
+        z = 0.0
+        for k in self.kids:
+            fk, zk = k.repulse(p, theta)
+            f += fk
+            z += zk
+        return f, z
+
+
+def bh_repulsion_ref(y, theta):
+    """Reference-faithful Barnes-Hut (2-D): returns (rep [N,2], Z)."""
+    lo, hi = y.min(axis=0), y.max(axis=0)
+    mean = y.mean(axis=0)
+    # root: Cell(mean, max side) as TsneHelpers.scala:248 (half = max range)
+    root = _QT(mean[0], mean[1], max(hi[0] - lo[0], hi[1] - lo[1]))
+    for p in y:
+        root.insert(p)
+    rep = np.zeros_like(y)
+    z = 0.0
+    for i, p in enumerate(y):
+        rep[i], zi = root.repulse(p, theta)
+        z += zi
+    return rep, z

@@ -97,7 +97,9 @@ def test_lossfile_alias():
 def test_pick_repulsion():
     assert pick_repulsion("auto", 0.0, 10 ** 6) == "exact"
     assert pick_repulsion("auto", 0.5, 1000) == "exact"
-    assert pick_repulsion("auto", 0.5, 10 ** 6) == "bh"
+    assert pick_repulsion("auto", 0.5, 10 ** 6) == "fft"
+    assert pick_repulsion("auto", 0.5, 10 ** 6, 3) == "fft"
+    assert pick_repulsion("bh", 0.5, 10) == "bh"
     assert pick_repulsion("fft", 0.5, 10) == "fft"
 
 

@@ -1,0 +1,81 @@
+"""FFT-interpolation repulsion tests: convergence to the exact sum, sharded
+row evaluation, and integration in the optimizer."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
+from tsne_flink_tpu.ops.repulsion_fft import fft_repulsion
+
+
+def embedding(n=400, m=2, seed=0, scale=10.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(5, m)) * scale
+    return jnp.asarray(centers[rng.integers(0, 5, n)] + rng.normal(size=(n, m)))
+
+
+@pytest.mark.parametrize("m,grid,tol", [(2, 256, 2e-3), (2, 512, 5e-4),
+                                        (3, 64, 2e-2)])
+def test_fft_converges_to_exact(m, grid, tol):
+    y = embedding(300, m, seed=1)
+    rep_f, z_f = fft_repulsion(y, grid=grid)
+    rep_e, z_e = exact_repulsion(y)
+    assert abs(float(z_f) - float(z_e)) / float(z_e) < tol
+    den = np.abs(np.asarray(rep_e)).max()
+    err = np.abs(np.asarray(rep_f) - np.asarray(rep_e)).max() / den
+    assert err < tol, f"m={m} grid={grid}: rel force error {err}"
+
+
+def test_fft_wide_embedding_adaptive_spacing():
+    # late-optimization regime: embedding span ~200 units (node spacing ~0.2
+    # at the default 1024 grid — the sizing rationale in repulsion_fft.py)
+    y = embedding(500, 2, seed=2, scale=40.0)
+    rep_f, z_f = fft_repulsion(y)
+    rep_e, z_e = exact_repulsion(y)
+    assert abs(float(z_f) - float(z_e)) / float(z_e) < 1e-3
+    den = np.abs(np.asarray(rep_e)).max()
+    assert np.abs(np.asarray(rep_f) - np.asarray(rep_e)).max() / den < 1e-3
+
+
+def test_fft_sharded_rows_match_full():
+    y = embedding(128, 2, seed=3)
+    rep_full, z_full = fft_repulsion(y, grid=256)
+    reps, zs = [], 0.0
+    for off in range(0, 128, 32):
+        r, z = fft_repulsion(y[off:off + 32], y, grid=256, row_offset=off)
+        reps.append(np.asarray(r))
+        zs += float(z)
+    np.testing.assert_allclose(np.concatenate(reps), np.asarray(rep_full),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(zs, float(z_full), rtol=1e-9)
+
+
+def test_fft_col_valid_excludes_padding():
+    y = embedding(100, 2, seed=4)
+    pad = jnp.concatenate([y, jnp.full((12, 2), 3.7)])
+    valid = jnp.arange(112) < 100
+    rep_p, z_p = fft_repulsion(pad, grid=512, col_valid=valid)
+    rep, z = fft_repulsion(y, grid=512)
+    np.testing.assert_allclose(float(z_p), float(z), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rep_p)[:100], np.asarray(rep),
+                               rtol=1e-5, atol=1e-10)
+    np.testing.assert_array_equal(np.asarray(rep_p)[100:], 0.0)
+
+
+def test_fft_inside_optimizer_runs():
+    from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+    from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+    from tsne_flink_tpu.ops.knn import knn_bruteforce
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(100, 6))
+    idx, dist = knn_bruteforce(jnp.asarray(x), 10)
+    p = pairwise_affinities(dist, 5.0)
+    jidx, jval = joint_distribution(idx, p)
+    y0 = jnp.asarray(rng.normal(size=(100, 2)) * 1e-4)
+    st = TsneState(y=y0, update=jnp.zeros_like(y0), gains=jnp.ones_like(y0))
+    cfg = TsneConfig(iterations=40, repulsion="fft", fft_grid=128)
+    got, losses = optimize(st, jidx, jval, cfg)
+    assert np.isfinite(np.asarray(got.y)).all()
+    assert np.isfinite(np.asarray(losses)).all()

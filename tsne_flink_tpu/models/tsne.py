@@ -35,10 +35,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+from tsne_flink_tpu.ops.affinities import affinity_pipeline
 from tsne_flink_tpu.ops.knn import knn as knn_dispatch
 from tsne_flink_tpu.ops.metrics import metric_fn
+from tsne_flink_tpu.ops.repulsion_bh import bh_repulsion
 from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
+from tsne_flink_tpu.ops.repulsion_fft import fft_repulsion
 
 LOSS_EVERY = 10  # TsneHelpers.scala:297
 
@@ -59,6 +61,11 @@ class TsneConfig:
     min_gain: float = 0.01  # TsneHelpers.scala:386
     repulsion: str = "exact"  # exact | bh | fft
     row_chunk: int = 2048
+    bh_levels: int | None = None   # None: auto depth (repulsion_bh.py)
+    bh_frontier: int = 32
+    bh_gate: str = "vdm"  # vdm (accurate, scale-free) | flink (reference parity)
+    fft_grid: int | None = None    # None: repulsion_fft.DEFAULT_GRID (1024/64)
+    fft_interp: int = 3            # Lagrange interpolation order
 
     @property
     def momentum_switch(self) -> int:
@@ -143,9 +150,17 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
     if cfg.repulsion == "exact":
         rep, sq = exact_repulsion(y_local, y_full, row_offset=row_offset,
                                   col_valid=valid_full, row_chunk=cfg.row_chunk)
+    elif cfg.repulsion == "bh":
+        rep, sq = bh_repulsion(y_local, y_full, theta=cfg.theta,
+                               levels=cfg.bh_levels, frontier=cfg.bh_frontier,
+                               gate=cfg.bh_gate, row_offset=row_offset,
+                               col_valid=valid_full, row_chunk=cfg.row_chunk)
+    elif cfg.repulsion == "fft":
+        rep, sq = fft_repulsion(y_local, y_full, grid=cfg.fft_grid,
+                                interp=cfg.fft_interp, row_offset=row_offset,
+                                col_valid=valid_full)
     else:
-        raise NotImplementedError(
-            f"repulsion='{cfg.repulsion}' lands in a later milestone")
+        raise ValueError(f"unknown repulsion backend '{cfg.repulsion}'")
     z = _psum(sq, axis_name)
     att, loss = _attractive_forces(y_local, y_full, jidx, jval, cfg.metric,
                                    exag, z, row_chunk=cfg.row_chunk)
@@ -175,12 +190,21 @@ def _center(state: TsneState, axis_name=None, valid=None):
 
 
 def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
-             axis_name=None, row_offset=0, valid=None):
+             axis_name=None, row_offset=0, valid=None,
+             start_iter=0, num_iters: int | None = None,
+             loss_carry=None):
     """Full 3-phase gradient descent as ONE compiled fori_loop.
 
     Returns (final TsneState, loss trace [iterations // 10]); trace slot t is
     the KL at global 1-based iteration 10·(t+1), matching the reference's
     every-10th-superstep accumulator keys (TsneHelpers.scala:297-300).
+
+    ``start_iter`` (traced) + ``num_iters`` (static) allow running a SEGMENT of
+    the schedule — the checkpoint/resume hook (a capability the reference
+    lacks: its failed jobs recompute from CSV, SURVEY §5).  Momentum /
+    exaggeration gates and loss slots all key off the absolute iteration, so
+    segmented runs are bit-identical to one full run.  ``loss_carry`` threads
+    the partially-filled loss trace between segments.
     """
     m0 = jnp.asarray(cfg.initial_momentum, state.y.dtype)
     m1 = jnp.asarray(cfg.final_momentum, state.y.dtype)
@@ -209,8 +233,11 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
             jnp.where(record, loss, loss_arr[slot]))
         return st, loss_arr
 
-    loss0 = jnp.zeros((n_slots,), state.y.dtype)
-    state, losses = lax.fori_loop(0, cfg.iterations, body, (state, loss0))
+    loss0 = (loss_carry if loss_carry is not None
+             else jnp.zeros((n_slots,), state.y.dtype))
+    num = cfg.iterations if num_iters is None else num_iters
+    start = jnp.asarray(start_iter, jnp.int32)
+    state, losses = lax.fori_loop(start, start + num, body, (state, loss0))
     return state, losses
 
 
@@ -226,10 +253,10 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
     k = neighbors if neighbors is not None else 3 * int(cfg.perplexity)
     key = jax.random.key(seed)
     kkey, ikey = jax.random.split(key)
-    idx, dist = knn_dispatch(x, k, knn_method, cfg.metric,
-                             blocks=knn_blocks, rounds=knn_iterations, key=kkey)
-    p_cond = pairwise_affinities(dist, cfg.perplexity)
-    jidx, jval = joint_distribution(idx, p_cond, sym_width)
+    idx, dist = jax.jit(lambda xx: knn_dispatch(
+        xx, k, knn_method, cfg.metric, blocks=knn_blocks,
+        rounds=knn_iterations, key=kkey))(x)
+    jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width)
     state = init_working_set(ikey, n, cfg.n_components, x.dtype)
     run = jax.jit(partial(optimize, cfg=cfg))
     state, losses = run(state, jidx, jval)

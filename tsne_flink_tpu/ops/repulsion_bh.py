@@ -1,0 +1,216 @@
+"""Barnes-Hut repulsion without pointers: an implicit complete quadtree/octree
+in dense per-level arrays, evaluated breadth-first with a bounded frontier.
+
+The reference builds ONE mutable pointer-chasing 2-D quadtree on a single task
+and broadcasts it (``TsneHelpers.scala:234-256``, ``QuadTree.scala``) — a
+sequential bottleneck and a structure that cannot live on a TPU.  Redesign:
+
+* The tree is *implicit*: level l of an m-D quadtree is the dense array of
+  ``2^(m·l)`` Morton-ordered grid cells over the embedding's bounding square
+  (cube).  A cell's children are the contiguous ids ``c*2^m .. c*2^m + 2^m-1``,
+  so per-level aggregates (point count, coordinate sum) are built bottom-up
+  from one ``segment_sum`` at the deepest level plus ``reshape(-1, 2^m).sum``
+  poolings — all MXU/VPU-friendly, no pointers, fully data-parallel (the
+  reference's ``tree.insert`` loop disappears).
+* Evaluation is vmapped over points.  Each point carries a frontier of at most
+  ``frontier`` candidate cells per level; a cell is *accepted* (contributes as
+  one body located at its center of mass) when the theta gate passes, and
+  *descended* otherwise.  Two gates are provided:
+
+  - ``gate="vdm"`` (default): the standard van-der-Maaten/bhtsne test
+    ``side_l / sqrt(D) < theta`` — scale-invariant, errors ~1e-2 at theta=0.5.
+  - ``gate="flink"``: the reference's test ``halfwidth_l / D < theta`` with
+    **D the squared distance** (``QuadTree.scala:133-134``).  Kept for
+    behavioral parity, but note it is not scale-invariant and is drastically
+    looser: measured against the exact sum on a 300-point clustered embedding,
+    the reference's own pointer quadtree at its default theta=0.25 shows ~98%
+    max force error and ~71% Z error (tests/oracle.py:bh_repulsion_ref) — the
+    "same knob, different scale" caveat of SURVEY §2.1 understates it.  Cells on the query's own ancestor chain are always descended.
+  If more than ``frontier`` cells want to descend, the farthest overflow cells
+  are accepted early (closest-first descent keeps the error tiny).
+* At the deepest level every remaining cell is accumulated; the query's own
+  leaf cell contributes with the query removed from its aggregates
+  (count-1, sum-y_i), which reproduces the reference's skip-self leaf rule
+  exactly when leaves are singletons (``QuadTree.scala:128``).
+
+theta = 0 never accepts, so every point descends to the leaves: with enough
+levels that occupied leaves are singletons this IS the exact sum — the same
+"theta=0 == no quadtree at all" oracle the reference tests use
+(``TsneHelpersTestSuite.scala:186-187``).
+
+Unlike the reference (2-D only, ``QuadTree.scala:156``), m=3 works: the same
+code builds an octree, enabling Barnes-Hut for --nComponents 3.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: Morton bit budget per dimension must keep ids in int32
+MAX_LEVELS = {2: 15, 3: 10}
+#: dense per-level arrays cost (2^m)^L cells — cap the memory at ~4M cells
+MEM_LEVELS = {2: 11, 3: 7}
+
+
+def default_levels(n: int, m: int) -> int:
+    """Deep enough that clustered points still resolve to ~singleton leaves
+    (measured: error plateaus ~3 levels past the uniform-occupancy depth),
+    capped by the dense-array memory budget."""
+    want = math.ceil(math.log(max(n, 2), 2**m)) + 3
+    return max(2, min(MEM_LEVELS[m], MAX_LEVELS[m], want))
+
+
+def _interleave(q: jnp.ndarray, m: int, levels: int) -> jnp.ndarray:
+    """Bit-interleave quantized [N, m] coords into Morton cell ids at the
+    deepest level.  Plain shift loop (levels <= 15 static iterations)."""
+    out = jnp.zeros(q.shape[0], jnp.int32)
+    for bit in range(levels - 1, -1, -1):
+        for d in range(m - 1, -1, -1):
+            out = (out << 1) | ((q[:, d] >> bit) & 1)
+    return out
+
+
+def build_tree(y_full: jnp.ndarray, levels: int,
+               col_valid: jnp.ndarray | None = None):
+    """Aggregate (counts, sums) per level, plus the quantization frame.
+
+    Returns (counts: list[l -> [B^l]], sums: list[l -> [B^l, m]], lo, side,
+    cell_of_point [N] at the deepest level).
+    """
+    n, m = y_full.shape
+    b = 2**m
+    lo = jnp.min(y_full, axis=0)
+    hi = jnp.max(y_full, axis=0)
+    side = jnp.maximum(jnp.max(hi - lo), jnp.finfo(y_full.dtype).tiny)
+    cells = 1 << levels
+    q = jnp.clip(jnp.floor((y_full - lo[None, :]) / side * cells),
+                 0, cells - 1).astype(jnp.int32)
+    leaf = _interleave(q, m, levels)
+
+    w = (jnp.ones((n,), y_full.dtype) if col_valid is None
+         else col_valid.astype(y_full.dtype))
+    counts = [None] * (levels + 1)
+    sums = [None] * (levels + 1)
+    counts[levels] = jax.ops.segment_sum(w, leaf, num_segments=b**levels)
+    sums[levels] = jax.ops.segment_sum(y_full * w[:, None], leaf,
+                                       num_segments=b**levels)
+    for l in range(levels - 1, -1, -1):
+        counts[l] = counts[l + 1].reshape(-1, b).sum(axis=1)
+        sums[l] = sums[l + 1].reshape(-1, b, m).sum(axis=1)
+    return counts, sums, lo, side, leaf
+
+
+def bh_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
+                 theta: float = 0.25, levels: int | None = None,
+                 frontier: int = 32, gate: str = "vdm", row_offset: int = 0,
+                 col_valid: jnp.ndarray | None = None, row_chunk: int = 8192):
+    """Theta-gated repulsive forces; same contract as ``exact_repulsion``:
+    returns (rep [len(y), m] unnormalized, partial Z)."""
+    if gate not in ("vdm", "flink"):
+        raise ValueError(f"unknown bh gate '{gate}'")
+    if y_full is None:
+        y_full = y
+    nloc, m = y.shape
+    nfull = y_full.shape[0]
+    if m not in MAX_LEVELS:
+        raise ValueError(f"bh repulsion supports 2 or 3 components, got {m}")
+    b = 2**m
+    levels = levels if levels is not None else default_levels(nfull, m)
+    dtype = y.dtype
+
+    counts, sums, lo, side, leaf_full = build_tree(y_full, levels, col_valid)
+    theta_ = jnp.asarray(theta, dtype)
+
+    def point_rep(yi, own_leaf):
+        """Frontier BFS for one point.  own_leaf = its deepest-level cell id."""
+        rep = jnp.zeros((m,), dtype)
+        sumq = jnp.zeros((), dtype)
+        # frontier of cell ids at the current level; -1 = empty slot
+        fr = jnp.full((frontier,), -1, jnp.int32).at[0].set(0)
+
+        for l in range(1, levels + 1):
+            # expand every frontier cell into its 2^m children
+            parents = fr  # [W]
+            kids = (parents[:, None] * b
+                    + jnp.arange(b, dtype=jnp.int32)[None, :]).reshape(-1)
+            alive = (parents[:, None] >= 0).repeat(b, axis=1).reshape(-1)
+            kids_safe = jnp.where(alive, kids, 0)
+            cnt = counts[l][kids_safe] * alive
+            sm = sums[l][kids_safe] * alive[:, None]
+            occupied = cnt > 0
+            com = sm / jnp.maximum(cnt, 1)[:, None]
+            diff = yi[None, :] - com
+            d2 = jnp.sum(diff * diff, axis=1)
+            half = side / (2 ** (l + 1))  # half-width of a level-l cell
+            own_cell = own_leaf >> (m * (levels - l))
+            on_chain = kids_safe == own_cell
+            if gate == "vdm":
+                # bhtsne gate: side / sqrt(D) < theta  <=>  side² < theta²·D
+                passed = (2 * half) ** 2 < theta_ * theta_ * d2
+            else:
+                # reference gate, QuadTree.scala:134: max(h,w)/D < theta, D=|.|²
+                passed = half < theta_ * d2
+            accept = occupied & ~on_chain & passed
+
+            if l < levels:
+                # accumulate accepted cells now
+                q = 1.0 / (1.0 + d2)
+                contrib = (cnt * q) * accept
+                sumq = sumq + jnp.sum(contrib)
+                rep = rep + jnp.sum((contrib * q)[:, None] * diff, axis=0)
+                # descend the rest; if > frontier want in, the farthest
+                # overflow cells are accepted instead (closest-first)
+                want = occupied & ~accept
+                rank_key = jnp.where(want, -d2, -jnp.inf)  # closest first
+                _, sel = lax.top_k(rank_key, frontier)
+                sel_want = want[sel]
+                fr = jnp.where(sel_want, kids_safe[sel], -1)
+                overflow = want & ~jnp.zeros_like(want).at[sel].set(
+                    sel_want, mode="drop")
+                q_o = 1.0 / (1.0 + d2)
+                contrib_o = (cnt * q_o) * overflow
+                sumq = sumq + jnp.sum(contrib_o)
+                rep = rep + jnp.sum((contrib_o * q_o)[:, None] * diff, axis=0)
+            else:
+                # deepest level: everything remaining is accumulated; the
+                # query's own leaf sheds the query itself from its aggregates
+                own = kids_safe == own_leaf
+                cnt_adj = jnp.where(own & occupied, cnt - 1, cnt)
+                sm_adj = jnp.where(own[:, None], sm - yi[None, :], sm)
+                occ = occupied & (cnt_adj > 0)
+                com_adj = sm_adj / jnp.maximum(cnt_adj, 1)[:, None]
+                diff_adj = yi[None, :] - com_adj
+                d2_adj = jnp.sum(diff_adj * diff_adj, axis=1)
+                q = 1.0 / (1.0 + d2_adj)
+                contrib = (cnt_adj * q) * occ
+                sumq = sumq + jnp.sum(contrib)
+                rep = rep + jnp.sum((contrib * q)[:, None] * diff_adj, axis=0)
+        return rep, sumq
+
+    # leaf ids of the local rows (for the self-exclusion chain)
+    rows = row_offset + jnp.arange(nloc)
+    own_leaves = leaf_full[rows]
+    row_ok = (jnp.ones((nloc,), bool) if col_valid is None
+              else col_valid[rows])
+
+    c = min(row_chunk, nloc)
+    nchunks = math.ceil(nloc / c)
+    pad = nchunks * c - nloc
+    yp = jnp.pad(y, ((0, pad), (0, 0)))
+    lp = jnp.pad(own_leaves, (0, pad))
+    okp = jnp.pad(row_ok, (0, pad))
+
+    def one_chunk(args):
+        yc, lc, okc = args
+        rep, sq = jax.vmap(point_rep)(yc, lc)
+        rep = rep * okc[:, None]
+        return rep, jnp.sum(sq * okc)
+
+    rep, sq = lax.map(one_chunk, (yp.reshape(nchunks, c, m),
+                                  lp.reshape(nchunks, c),
+                                  okp.reshape(nchunks, c)))
+    return rep.reshape(-1, m)[:nloc], jnp.sum(sq)

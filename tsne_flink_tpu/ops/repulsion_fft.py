@@ -1,0 +1,166 @@
+"""FFT-accelerated repulsion (polynomial interpolation + circulant convolution).
+
+The third repulsion backend, beyond anything the reference has: the Student-t
+kernels are translation-invariant, so the N-body sums
+
+    Z      = sum_{i!=j} K1(y_i - y_j),          K1(r) = 1/(1+|r|^2)
+    rep_i  = sum_j K2(y_i - y_j) (y_i - y_j),   K2(r) = 1/(1+|r|^2)^2
+           = y_i * phi[K2, 1](y_i) - phi[K2, y](y_i)
+
+reduce to kernel convolutions phi[K, w](x) = sum_j K(x - y_j) w_j evaluated at
+the points.  Following the FIt-SNE construction (Linderman et al., "Fast
+interpolation-based t-SNE", the technique referenced in PAPERS.md; public
+algorithm), each charge is spread onto a regular G^m grid through order-p
+Lagrange interpolation, the grid is convolved with the kernel by FFT (circulant
+embedding of size (2G)^m), and the potentials are gathered back at the points
+with the same interpolation weights.  O(N p^m + G^m log G) per iteration
+instead of O(N^2) — and every stage is dense, regular, and MXU/FFT-friendly,
+which is exactly what the TPU wants (this is the 1M-point path).
+
+Accuracy is governed by the node spacing h = side/G relative to the kernel's
+unit length-scale; with p = 3 and h <= 0.25 the relative force error is ~1e-3
+(see tests/test_fft.py).  The grid size is static under jit; the spacing
+adapts to the embedding's bounding box each iteration.
+
+Self-interactions: K1(0) = 1 contributes N to the Z convolution (subtracted);
+K2(0) * (y_i - y_i) = 0 contributes nothing to the force.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: node spacing must stay well under the kernel's unit scale as the embedding
+#: spreads out late in optimization (span ~100-200 units): 1024 nodes keeps
+#: h <= 0.2 there, and a 2048² real FFT is still sub-millisecond on TPU
+DEFAULT_GRID = {2: 1024, 3: 64}
+
+
+def _lagrange_weights(t: jnp.ndarray, p: int) -> jnp.ndarray:
+    """Lagrange basis values at fractional offset t in [0,1) for p equispaced
+    integer nodes -(p-1)//2 .. p-1-(p-1)//2 (relative to floor(t)=0).
+    Returns [..., p]: L_a(t) = prod_{b != a} (t - node_b) / (node_a - node_b)."""
+    base = -((p - 1) // 2)
+    nodes = [float(base + a) for a in range(p)]
+    cols = []
+    for a in range(p):
+        w = jnp.ones_like(t)
+        for b in range(p):
+            if b != a:
+                w = w * (t - nodes[b]) / (nodes[a] - nodes[b])
+        cols.append(w)
+    return jnp.stack(cols, axis=-1)
+
+
+def fft_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
+                  grid: int | None = None, interp: int = 3,
+                  row_offset: int = 0, col_valid: jnp.ndarray | None = None,
+                  **_unused):
+    """Same contract as exact_repulsion: (rep [len(y), m], partial-Z scalar).
+
+    NOTE on sharding: like the BH tree build, the grid is built from the
+    all-gathered ``y_full`` on every device (the grid is small; rebuilding
+    beats psum-ing it), while gathering happens only for the local rows, so
+    the returned Z is the *local* partial sum — psum it like the others.
+    """
+    if y_full is None:
+        y_full = y
+    nloc, m = y.shape
+    nfull = y_full.shape[0]
+    g = grid if grid is not None else DEFAULT_GRID.get(m)
+    if g is None:
+        raise ValueError(f"fft repulsion supports 2 or 3 components, got {m}")
+    p = interp
+    dtype = y.dtype
+
+    # bounding box -> node spacing (static grid, dynamic spacing)
+    lo = jnp.min(y_full, axis=0)
+    hi = jnp.max(y_full, axis=0)
+    side = jnp.maximum(jnp.max(hi - lo), jnp.asarray(1e-6, dtype))
+    half_sten = (p - 1) // 2
+    h = side / (g - p)  # leaves stencil margin on both sides
+    origin = lo - half_sten * h  # low-side margin = stencil reach
+
+    # per-point stencil: base index and Lagrange weights per dim.
+    # clip FIRST, then take frac relative to the clipped index — otherwise a
+    # boundary point whose floor() lands one node off gets weights for the
+    # wrong stencil (measured: 6% force error on the bounding-box corner)
+    u = (y_full - origin[None, :]) / h  # fractional node coords, [N, m]
+    idx0 = jnp.clip(jnp.floor(u).astype(jnp.int32),
+                    half_sten, g - p + half_sten)
+    frac = u - idx0
+    wdim = _lagrange_weights(frac, p)  # [N, m, p]
+
+    # charges: [1, y_0..y_{m-1}] for K2; the unit charge also serves K1·1
+    valid_w = (jnp.ones((nfull,), dtype) if col_valid is None
+               else col_valid.astype(dtype))
+    charges = jnp.concatenate([valid_w[:, None], y_full * valid_w[:, None]],
+                              axis=1)  # [N, 1+m]
+    nch = 1 + m
+
+    # ---- spread: p^m scatter-adds via segment_sum over flattened cell ids
+    grid_ch = jnp.zeros((g**m, nch), dtype)
+    base = idx0 - (p - 1) // 2
+    for offs in itertools.product(range(p), repeat=m):
+        w = jnp.ones((nfull,), dtype)
+        flat = jnp.zeros((nfull,), jnp.int32)
+        for d in range(m):
+            w = w * wdim[:, d, offs[d]]
+            flat = flat * g + (base[:, d] + offs[d])
+        grid_ch = grid_ch + jax.ops.segment_sum(
+            charges * w[:, None], flat, num_segments=g**m)
+    grid_ch = grid_ch.reshape((g,) * m + (nch,))
+
+    # ---- FFT convolution with K1 and K2 on the embedded 2G circulant grid
+    coords = jnp.minimum(jnp.arange(2 * g), 2 * g - jnp.arange(2 * g)) * h
+    r2 = jnp.zeros((2 * g,) * m, dtype)
+    for d in range(m):
+        shape = [1] * m
+        shape[d] = 2 * g
+        r2 = r2 + (coords.reshape(shape)) ** 2
+    k1 = 1.0 / (1.0 + r2)
+    k2 = k1 * k1
+
+    pad_widths = [(0, g)] * m + [(0, 0)]
+    gpad = jnp.pad(grid_ch, pad_widths)
+    axes = tuple(range(m))
+    ghat = jnp.fft.rfftn(gpad, axes=axes)
+    k1hat = jnp.fft.rfftn(k1, axes=axes)
+    k2hat = jnp.fft.rfftn(k2, axes=axes)
+    # channel 0 under K1 (for Z); all channels under K2 (for forces)
+    conv_z = jnp.fft.irfftn(ghat[..., 0] * k1hat, axes=axes,
+                            s=(2 * g,) * m)
+    conv_f = jnp.fft.irfftn(ghat * k2hat[..., None], axes=axes,
+                            s=(2 * g,) * m)
+    sl = tuple(slice(0, g) for _ in range(m))
+    pot_z = conv_z[sl]            # [g]*m
+    pot_f = conv_f[sl]            # [g]*m + [nch]
+
+    # ---- gather at the local rows
+    rows = row_offset + jnp.arange(nloc)
+    b_loc = base[rows]
+    w_loc = wdim[rows]
+    y_loc_w = valid_w[rows]
+
+    phi_z = jnp.zeros((nloc,), dtype)
+    phi_f = jnp.zeros((nloc, nch), dtype)
+    pot_z_flat = pot_z.reshape(-1)
+    pot_f_flat = pot_f.reshape(-1, nch)
+    for offs in itertools.product(range(p), repeat=m):
+        w = jnp.ones((nloc,), dtype)
+        flat = jnp.zeros((nloc,), jnp.int32)
+        for d in range(m):
+            w = w * w_loc[:, d, offs[d]]
+            flat = flat * g + (b_loc[:, d] + offs[d])
+        phi_z = phi_z + w * pot_z_flat[flat]
+        phi_f = phi_f + w[:, None] * pot_f_flat[flat]
+
+    rep = (y[:, :] * phi_f[:, :1] - phi_f[:, 1:]) * y_loc_w[:, None]
+    # local partial Z: each local point's K1 potential minus its self-term
+    sum_q = jnp.sum((phi_z - 1.0) * y_loc_w)
+    return rep, sum_q

@@ -60,7 +60,13 @@ def pad_rows(a: jnp.ndarray, n_pad: int, fill=0):
 class ShardedOptimizer:
     """Callable running :func:`tsne_flink_tpu.models.tsne.optimize` under
     shard_map on a 1-D point mesh.  With one device it degrades to plain jit
-    of the identical program."""
+    of the identical program.
+
+    Supports segmented execution for checkpoint/resume: the compiled program
+    takes a traced ``start_iter`` and a partially-filled loss trace, so the
+    same executable serves every segment and the result is bit-identical to
+    one uninterrupted run.
+    """
 
     def __init__(self, cfg: TsneConfig, n: int, n_devices: int | None = None):
         self.cfg = cfg
@@ -70,27 +76,34 @@ class ShardedOptimizer:
         d = self.n_devices
         self.n_padded = math.ceil(n / d) * d
         self.n_local = self.n_padded // d
+        self._fns = {}  # num_iters (static) -> compiled segment runner
 
-        if d == 1:
-            self._fn = jax.jit(partial(optimize, cfg=cfg))
-            return
+    def _segment_fn(self, num_iters: int):
+        if num_iters in self._fns:
+            return self._fns[num_iters]
+        cfg_ = self.cfg
+        if self.n_devices == 1:
+            fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters))
+        else:
+            n_local = self.n_local
 
-        cfg_ = cfg
-        n_local = self.n_local
+            def local_run(state, jidx, jval, valid, start_iter, loss_carry):
+                row_offset = lax.axis_index(AXIS) * n_local
+                return optimize(state, jidx, jval, cfg_, axis_name=AXIS,
+                                row_offset=row_offset, valid=valid,
+                                start_iter=start_iter, num_iters=num_iters,
+                                loss_carry=loss_carry)
 
-        def local_run(state, jidx, jval, valid):
-            row_offset = lax.axis_index(AXIS) * n_local
-            return optimize(state, jidx, jval, cfg_, axis_name=AXIS,
-                            row_offset=row_offset, valid=valid)
-
-        pspec = P(AXIS)
-        state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
-        self._fn = jax.jit(
-            jax.shard_map(
-                local_run, mesh=self.mesh,
-                in_specs=(state_spec, pspec, pspec, pspec),
-                out_specs=(state_spec, P()),  # loss trace is psum-replicated
-            ))
+            pspec = P(AXIS)
+            state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
+            fn = jax.jit(
+                jax.shard_map(
+                    local_run, mesh=self.mesh,
+                    in_specs=(state_spec, pspec, pspec, pspec, P(), P()),
+                    out_specs=(state_spec, P()),  # loss trace psum-replicated
+                ))
+        self._fns[num_iters] = fn
+        return fn
 
     def _pad_inputs(self, state: TsneState, jidx, jval):
         npad = self.n_padded - self.n
@@ -102,19 +115,61 @@ class ShardedOptimizer:
         valid = jnp.arange(self.n_padded) < self.n
         return state, jidx, jval, valid
 
-    def lower(self, state, jidx, jval):
-        if self.n_devices == 1:
-            return self._fn.lower(state, jidx, jval)
-        return self._fn.lower(*self._pad_inputs(state, jidx, jval))
+    def _unpad(self, state: TsneState) -> TsneState:
+        return TsneState(y=state.y[: self.n], update=state.update[: self.n],
+                         gains=state.gains[: self.n])
 
-    def __call__(self, state: TsneState, jidx, jval):
+    def _loss0(self, dtype):
+        return jnp.zeros((max(self.cfg.n_loss_slots, 1),), dtype)
+
+    def lower(self, state, jidx, jval):
+        fn = self._segment_fn(self.cfg.iterations)
         if self.n_devices == 1:
-            return self._fn(state, jidx, jval)
+            return fn.lower(state, jidx, jval, start_iter=0,
+                            loss_carry=self._loss0(state.y.dtype))
         state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
-        out_state, losses = self._fn(state, jidx, jval, valid)
-        return TsneState(y=out_state.y[: self.n],
-                         update=out_state.update[: self.n],
-                         gains=out_state.gains[: self.n]), losses
+        return fn.lower(state, jidx, jval, valid, 0,
+                        self._loss0(state.y.dtype))
+
+    def _run_segment(self, fn, state, jidx, jval, valid, start, losses):
+        if self.n_devices == 1:
+            return fn(state, jidx, jval, start_iter=start, loss_carry=losses)
+        return fn(state, jidx, jval, valid, start, losses)
+
+    def __call__(self, state: TsneState, jidx, jval, *, start_iter: int = 0,
+                 loss_carry=None, checkpoint_every: int = 0,
+                 checkpoint_cb=None):
+        """Run iterations [start_iter, cfg.iterations); if checkpointing,
+        ``checkpoint_cb(state, next_iter, losses)`` fires every
+        ``checkpoint_every`` iterations with the UNPADDED state."""
+        if self.n_devices == 1:
+            valid = None
+        else:
+            state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
+        if loss_carry is not None:
+            losses = jnp.asarray(loss_carry, state.y.dtype)
+            want = max(self.cfg.n_loss_slots, 1)
+            if losses.shape[0] < want:  # resumed into a longer schedule
+                losses = jnp.pad(losses, (0, want - losses.shape[0]))
+            elif losses.shape[0] > want:
+                losses = losses[:want]
+        else:
+            losses = self._loss0(state.y.dtype)
+        total = self.cfg.iterations
+        seg = (checkpoint_every if checkpoint_every
+               and checkpoint_cb is not None else total - start_iter)
+        it = start_iter
+        while it < total:
+            step = min(seg, total - it)
+            if step <= 0:
+                break
+            fn = self._segment_fn(step)
+            state, losses = self._run_segment(fn, state, jidx, jval, valid,
+                                              it, losses)
+            it += step
+            if checkpoint_cb is not None and it < total:
+                checkpoint_cb(self._unpad(state), it, losses)
+        return self._unpad(state), losses
 
 
 def shard_pipeline(cfg: TsneConfig, n: int,

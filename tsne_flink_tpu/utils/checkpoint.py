@@ -1,0 +1,57 @@
+"""Checkpoint / resume of the optimizer working set.
+
+The reference has NO checkpointing — a failed Flink job recomputes everything
+from CSV (SURVEY §5 "Checkpoint / resume: absent").  Here the full working set
+(y, lastUpdate, gains — the reference's 4-tuple minus the index column), the
+next iteration number, and the partial loss trace are saved as one ``.npz``;
+resuming reproduces the uninterrupted run bit-for-bit because the segmented
+optimizer keys every schedule gate off the absolute iteration
+(``models/tsne.py:optimize``).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from tsne_flink_tpu.models.tsne import TsneState
+
+MAGIC = "tsne_flink_tpu-ckpt-v1"
+
+
+def save(path: str, state: TsneState, next_iter: int,
+         losses: np.ndarray) -> None:
+    """Atomic write (tmp + rename) so an interrupt never corrupts the file."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, magic=MAGIC, y=np.asarray(state.y),
+                     update=np.asarray(state.update),
+                     gains=np.asarray(state.gains),
+                     next_iter=int(next_iter), losses=np.asarray(losses))
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+class NotACheckpoint(ValueError):
+    pass
+
+
+def load(path: str):
+    """Returns (TsneState (numpy arrays), next_iter, losses)."""
+    try:
+        with np.load(path) as z:
+            if str(z["magic"]) != MAGIC:
+                raise NotACheckpoint(f"{path} is not a tsne_flink_tpu checkpoint")
+            state = TsneState(y=z["y"], update=z["update"], gains=z["gains"])
+            return state, int(z["next_iter"]), z["losses"]
+    except NotACheckpoint:
+        raise
+    except (ValueError, KeyError, OSError) as e:
+        raise NotACheckpoint(
+            f"{path} is not a tsne_flink_tpu checkpoint ({e})") from e
