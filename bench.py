@@ -31,6 +31,9 @@ def make_data(n=60_000, d=784, classes=10, seed=0):
 
 
 def main():
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    enable_compilation_cache()
+
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,63 @@
+"""Per-stage compile-vs-execute timing at bench shapes.
+
+Usage: python scripts/profile_stages.py [n] [iters] [repulsion]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import make_data  # noqa: E402
+
+
+def t(fn):
+    t0 = time.time()
+    out = fn()
+    return out, time.time() - t0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
+    from tsne_flink_tpu.ops.affinities import affinity_pipeline
+    from tsne_flink_tpu.ops.knn import knn_project
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    repulsion = sys.argv[3] if len(sys.argv) > 3 else "fft"
+    k = 90
+
+    x = jnp.asarray(make_data(n))
+    cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=0.5,
+                     repulsion=repulsion, row_chunk=4096)
+
+    knn_fn = jax.jit(lambda xx: knn_project(xx, k, rounds=3,
+                                            key=jax.random.key(0)))
+    _, c_knn = t(lambda: jax.block_until_ready(knn_fn(x)))
+    (idx, dist), r_knn = t(lambda: jax.block_until_ready(knn_fn(x)))
+    print(f"knn:        compile+run {c_knn:7.2f}s   steady {r_knn:7.2f}s")
+
+    _, c_aff = t(lambda: jax.block_until_ready(
+        affinity_pipeline(idx, dist, cfg.perplexity)))
+    (jidx, jval), r_aff = t(lambda: jax.block_until_ready(
+        affinity_pipeline(idx, dist, cfg.perplexity)))
+    print(f"affinities: compile+run {c_aff:7.2f}s   steady {r_aff:7.2f}s   "
+          f"sym_width={jidx.shape[1]}")
+
+    state = init_working_set(jax.random.key(0), n, 2, jnp.float32)
+    runner = ShardedOptimizer(cfg, n)
+    _, c_opt = t(lambda: jax.block_until_ready(
+        runner(state, jidx, jval)[0].y))
+    (st2, losses), r_opt = t(lambda: jax.block_until_ready(
+        runner(state, jidx, jval)))
+    print(f"optimize:   compile+run {c_opt:7.2f}s   steady {r_opt:7.2f}s   "
+          f"({iters} iters, {r_opt / iters * 1e3:.1f} ms/iter, "
+          f"repulsion={repulsion}, KL={float(losses[-1]):.4f})")
+
+
+if __name__ == "__main__":
+    main()

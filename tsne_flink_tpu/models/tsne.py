@@ -41,6 +41,7 @@ from tsne_flink_tpu.ops.metrics import metric_fn
 from tsne_flink_tpu.ops.repulsion_bh import bh_repulsion
 from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
 from tsne_flink_tpu.ops.repulsion_fft import fft_repulsion
+from tsne_flink_tpu.ops.repulsion_pallas import pallas_exact_repulsion
 
 LOSS_EVERY = 10  # TsneHelpers.scala:297
 
@@ -60,6 +61,7 @@ class TsneConfig:
     metric: str = "sqeuclidean"
     min_gain: float = 0.01  # TsneHelpers.scala:386
     repulsion: str = "exact"  # exact | bh | fft
+    exact_impl: str = "auto"  # auto | xla | pallas (auto: pallas on TPU f32)
     row_chunk: int = 2048
     bh_levels: int | None = None   # None: auto depth (repulsion_bh.py)
     bh_frontier: int = 32
@@ -148,8 +150,20 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
     y_full = (y_local if axis_name is None
               else lax.all_gather(y_local, axis_name, tiled=True))
     if cfg.repulsion == "exact":
-        rep, sq = exact_repulsion(y_local, y_full, row_offset=row_offset,
-                                  col_valid=valid_full, row_chunk=cfg.row_chunk)
+        impl = cfg.exact_impl
+        if impl == "auto":
+            # fused pallas kernel on TPU (f32/bf16); the XLA tiled sweep
+            # elsewhere (CPU tests run f64, which pallas would truncate)
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    and y_local.dtype != jnp.float64 else "xla")
+        if impl == "pallas":
+            rep, sq = pallas_exact_repulsion(y_local, y_full,
+                                             row_offset=row_offset,
+                                             col_valid=valid_full)
+        else:
+            rep, sq = exact_repulsion(y_local, y_full, row_offset=row_offset,
+                                      col_valid=valid_full,
+                                      row_chunk=cfg.row_chunk)
     elif cfg.repulsion == "bh":
         rep, sq = bh_repulsion(y_local, y_full, theta=cfg.theta,
                                levels=cfg.bh_levels, frontier=cfg.bh_frontier,

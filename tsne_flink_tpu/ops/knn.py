@@ -26,7 +26,6 @@ candidate rounds) carry ``dist == +inf``; downstream consumers mask on
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -120,39 +119,39 @@ def knn_partition(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
             dist.reshape(-1, k)[:n])
 
 
-def _window_candidates(perm: jnp.ndarray, k: int, n: int) -> jnp.ndarray:
-    """For each point, the k predecessors + k successors along a sort order.
-
-    Mirrors the reference's ±k candidate window over the Z-order sorted
-    sequence (``TsneHelpers.scala:146-156``).  Returns [n, 2k] candidate ids in
-    *original point order*; missing slots (sequence edges) carry sentinel ``n``.
-    """
-    perm = perm.astype(jnp.int32)
-    sentinel = jnp.full((k,), n, dtype=jnp.int32)
-    padded = jnp.concatenate([sentinel, perm, sentinel])
-    offs = jnp.concatenate([jnp.arange(k), jnp.arange(k + 1, 2 * k + 1)]).astype(jnp.int32)
-    pos = jnp.arange(n, dtype=jnp.int32)[:, None] + offs[None, :]
-    win = padded[pos]  # [n, 2k] neighbors of sorted position i
-    out = jnp.zeros((n, 2 * k), jnp.int32).at[perm].set(win)
-    return out
-
-
 def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                 rounds: int = 3, key: jax.Array | None = None,
-                *, proj_dims: int = 3, rerank_budget: int = 1 << 27):
-    """Approximate kNN via random-shift Z-order rounds + exact re-rank.
+                *, proj_dims: int = 3, block: int = 512):
+    """Approximate kNN via random-shift Z-order rounds + exact banded re-rank.
 
     Reference ``projectKnn`` (``TsneHelpers.scala:93-160``): 1 unshifted round +
     (rounds-1) rounds shifted by a random vector, Z-order sort, ±k window
     candidates, union, dedup, exact-metric top-k.
 
-    TPU redesign: for dim > 3 the Z-order runs over a random Gaussian projection
-    to ``proj_dims`` dims (the reference's full-dim lazy comparator has no
-    array-key equivalent; locality is preserved in the JL sense and the exact
-    re-rank below makes the final distances exact either way).  Shifts are drawn
-    per-dimension as U[0,1) *fractions of the data span* — scale-free, unlike
-    the reference's absolute U[0,1) shift (``TsneHelpers.scala:97-99``) which
-    silently degrades on data whose scale is far from 1.
+    TPU redesign, in two parts:
+
+    * for dim > 3 the Z-order runs over a random Gaussian projection to
+      ``proj_dims`` dims (the reference's full-dim lazy comparator has no
+      array-key equivalent; locality is preserved in the JL sense and the exact
+      re-rank makes the final distances exact either way).  Shifts are drawn
+      per-dimension as U[0,1) *fractions of the data span* — scale-free, unlike
+      the reference's absolute U[0,1) shift (``TsneHelpers.scala:97-99``) which
+      silently degrades on data whose scale is far from 1.  A FRESH projection
+      is drawn per round: unlike a shift it changes which structure the Z-curve
+      can see, so rounds contribute far more diverse candidates in high dim.
+    * the candidate window + exact re-rank happen entirely in SORTED space:
+      points are physically permuted into Z-order once per round, and each
+      sorted row block of ``block`` points computes exact metric distances to
+      the contiguous column band [blockstart - k, blockend + k) — one MXU tile
+      per block, zero per-candidate gathers (a gather-based re-rank moves
+      ~N·2k·dim·rounds bytes through random access; the band moves the same
+      FLOPs as dense contiguous matmuls).  Every point sees at least its ±k
+      sorted neighbors — a superset of the reference's candidate set
+      (``TsneHelpers.scala:146-156``), so recall can only be higher.
+
+    Per-round top-k results are merged across rounds by per-row id-sort dedup
+    and a final smallest-k — the regular-array form of the reference's
+    union/groupBy dedup/re-rank (``TsneHelpers.scala:113-133``).
     """
     n, dim = x.shape
     k = _clamp_k(k, n)
@@ -163,9 +162,6 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
 
     def round_coords(it: int, key):
         if dim > m:
-            # fresh random projection each round: unlike a shift, a new
-            # projection changes WHICH structure the Z-curve can see, so
-            # rounds contribute far more diverse candidates in high dim
             pkey, skey = jax.random.split(key)
             r = jax.random.normal(pkey, (dim, m), x.dtype) / jnp.sqrt(
                 jnp.asarray(dim, x.dtype))
@@ -178,39 +174,62 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
             z = z + jax.random.uniform(skey, (m,), z.dtype) * span
         return z
 
-    cands = []
+    b = int(min(block, n))
+    nb = math.ceil(n / b)
+    npad = nb * b
+    band = b + 2 * k  # columns seen by one row block
+
+    def one_round(it, key):
+        z = round_coords(it, key)
+        perm = zorder_permutation(z).astype(jnp.int32)
+        xs = x[perm]  # physically Z-sorted points: bands are contiguous
+        xs_pad = jnp.pad(xs, ((k, npad - n + k), (0, 0)))
+        bstarts = jnp.arange(nb, dtype=jnp.int32) * b
+
+        def one_block(s):
+            rows = lax.dynamic_slice_in_dim(xs_pad, s + k, b)      # [b, dim]
+            cols = lax.dynamic_slice_in_dim(xs_pad, s, band)       # [band, dim]
+            d = pairwise(metric, rows, cols)                       # MXU tile
+            rpos = s + jnp.arange(b, dtype=jnp.int32)              # sorted pos
+            cpos = s - k + jnp.arange(band, dtype=jnp.int32)
+            bad = ((cpos[None, :] < 0) | (cpos[None, :] >= n)
+                   | (rpos[:, None] == cpos[None, :])
+                   | (rpos[:, None] >= n))
+            d = jnp.where(bad, jnp.inf, d)
+            dd, sel = _topk_smallest(d, k)
+            gpos = jnp.clip(cpos[sel], 0, n - 1)                   # [b, k]
+            return dd, perm[gpos]
+
+        dist_s, idx_s = lax.map(one_block, bstarts)                # sorted order
+        dist_s = dist_s.reshape(npad, k)[:n]
+        idx_s = idx_s.reshape(npad, k)[:n]
+        # back to original point order: row p of the sorted result is point perm[p]
+        dist = jnp.zeros((n, k), x.dtype).at[perm].set(dist_s)
+        idx = jnp.zeros((n, k), jnp.int32).at[perm].set(idx_s)
+        return dist, idx
+
+    dists, idxs = [], []
     for it in range(max(1, rounds)):
         key, rkey = jax.random.split(key)
-        z = round_coords(it, rkey)
-        cands.append(_window_candidates(zorder_permutation(z), k, n))
-    cand = jnp.concatenate(cands, axis=1)  # [n, 2k*rounds]
+        d, i = one_round(it, rkey)
+        dists.append(d)
+        idxs.append(i)
 
-    # dedup per row: sort ids, mark repeats with the sentinel
-    cand = jnp.sort(cand, axis=1)
-    dup = jnp.concatenate(
-        [jnp.zeros((n, 1), bool), cand[:, 1:] == cand[:, :-1]], axis=1)
-    cand = jnp.where(dup, n, cand)
+    if len(dists) == 1:
+        return idxs[0], dists[0]
 
-    # exact re-rank (row-chunked so [rows, C, dim] stays within budget)
-    cwidth = cand.shape[1]
-    f = metric_fn(metric)
-    rows = int(min(n, max(1, rerank_budget // max(1, cwidth * dim))))
-    nchunks = math.ceil(n / rows)
-    xpad = jnp.pad(x, ((0, nchunks * rows - n), (0, 0)))
-    cpad = jnp.pad(cand, ((0, nchunks * rows - n), (0, 0)), constant_values=n)
-
-    def rerank(args):
-        xc, cc = args
-        xn = x[jnp.minimum(cc, n - 1)]            # [rows, C, dim]
-        d = f(xc[:, None, :], xn)                 # exact metric, parity with :126
-        d = jnp.where(cc == n, jnp.inf, d)
-        dd, sel = _topk_smallest(d, k)
-        return dd, jnp.take_along_axis(cc, sel, axis=1)
-
-    dist, idx = lax.map(rerank, (xpad.reshape(nchunks, rows, dim),
-                                 cpad.reshape(nchunks, rows, cwidth)))
-    return (idx.reshape(-1, k)[:n].astype(jnp.int32),
-            dist.reshape(-1, k)[:n])
+    # merge rounds: per-row sort by neighbor id, mark duplicates, smallest-k
+    cat_d = jnp.concatenate(dists, axis=1)
+    cat_i = jnp.concatenate(idxs, axis=1)
+    order = jnp.argsort(cat_i, axis=1)
+    cat_i = jnp.take_along_axis(cat_i, order, axis=1)
+    cat_d = jnp.take_along_axis(cat_d, order, axis=1)
+    dup = jnp.concatenate([jnp.zeros((n, 1), bool),
+                           (cat_i[:, 1:] == cat_i[:, :-1])
+                           & jnp.isfinite(cat_d[:, 1:])], axis=1)
+    cat_d = jnp.where(dup, jnp.inf, cat_d)
+    dd, sel = _topk_smallest(cat_d, k)
+    return jnp.take_along_axis(cat_i, sel, axis=1), dd
 
 
 def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",

@@ -85,6 +85,9 @@ def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2) -> st
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    enable_compilation_cache()
+
     import jax
     import jax.numpy as jnp
     import numpy as np

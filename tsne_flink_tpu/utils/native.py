@@ -1,0 +1,105 @@
+"""Build-on-first-use ctypes bindings for the native CSV runtime
+(``native/fastcsv.cpp``).
+
+The reference's ingest is Flink's JVM-native parallel CSV source
+(``Tsne.scala:138-159``); the TPU framework's host runtime equivalent is a
+small C++ library (mmap + ``std::from_chars``), compiled once with the
+toolchain baked into the image and loaded via ctypes (no pybind11 available).
+Everything degrades gracefully to the pure-numpy path in
+:mod:`tsne_flink_tpu.utils.io` if no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "fastcsv.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _build_dir() -> str:
+    d = os.environ.get("TSNE_TPU_NATIVE_CACHE",
+                       os.path.join(os.path.dirname(_SRC), "build"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            with open(_SRC, "rb") as f:
+                tag = hashlib.sha256(f.read()).hexdigest()[:16]
+            so = os.path.join(_build_dir(), f"fastcsv-{tag}.so")
+            if not os.path.exists(so):
+                tmp = so + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-o", tmp, _SRC],
+                    check=True, capture_output=True)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            lib.coo_count_rows.argtypes = [ctypes.c_char_p]
+            lib.coo_count_rows.restype = ctypes.c_longlong
+            lib.coo_parse.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_longlong, ctypes.c_int]
+            lib.coo_parse.restype = ctypes.c_longlong
+            lib.write_embedding.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_longlong, ctypes.c_int]
+            lib.write_embedding.restype = ctypes.c_longlong
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_coo(path: str, cols: int = 3) -> np.ndarray | None:
+    """Parse a numeric CSV into an [rows, cols] float64 array; None if the
+    native library is unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    pathb = os.fsencode(path)
+    rows = lib.coo_count_rows(pathb)
+    if rows < 0:
+        raise OSError(f"cannot read {path}")
+    out = np.empty((rows, cols), np.float64)
+    got = lib.coo_parse(pathb, out, rows, cols)
+    if got < 0:
+        raise ValueError(f"{path}: malformed CSV at line {-got - 1}")
+    return out[:got]
+
+
+def write_embedding(path: str, ids: np.ndarray, y: np.ndarray) -> bool:
+    """Native fast path for the embedding writer; False -> caller falls back."""
+    lib = _load()
+    if lib is None:
+        return False
+    ids64 = np.ascontiguousarray(ids, np.int64)
+    y64 = np.ascontiguousarray(y, np.float64)
+    n = lib.write_embedding(os.fsencode(path), ids64, y64,
+                            y64.shape[0], y64.shape[1])
+    if n < 0:
+        raise OSError(f"cannot write {path}")
+    return True
