@@ -152,3 +152,14 @@ def test_tsne_embed_end_to_end_kl_decreases():
     # KL under plain P (post-exaggeration slots) must improve over time
     assert losses[-1] < losses[10 + 1]  # slot 11 ~ iter 120, after switch at 101
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_center_input_parity():
+    # centerInput (TsneHelpers.scala:331-339) — dead code in the reference but
+    # part of its public step API; here it must zero the mean exactly
+    from tsne_flink_tpu.models.tsne import center_input
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(31, 5)) + 3.0
+    xc = np.asarray(center_input(jnp.asarray(x)))
+    np.testing.assert_allclose(xc.mean(axis=0), 0.0, atol=1e-12)
+    np.testing.assert_allclose(xc, x - x.mean(axis=0), atol=1e-12)

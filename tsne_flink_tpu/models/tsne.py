@@ -191,16 +191,31 @@ def _update_embedding(state: TsneState, grad, momentum, cfg: TsneConfig):
     return TsneState(y=state.y + update, update=update, gains=gains)
 
 
+def _global_mean(x, axis_name=None, valid=None):
+    """Mean over the (global, psum'd) point axis, ignoring padded rows."""
+    if valid is None:
+        total = _psum(jnp.sum(x, axis=0), axis_name)
+        count = _psum(jnp.asarray(x.shape[0], x.dtype), axis_name)
+    else:
+        w = valid.astype(x.dtype)
+        total = _psum(jnp.sum(x * w[:, None], axis=0), axis_name)
+        count = _psum(jnp.sum(w), axis_name)
+    return total / count
+
+
 def _center(state: TsneState, axis_name=None, valid=None):
     """Subtract the (global) mean each iteration (TsneHelpers.scala:320-329)."""
-    if valid is None:
-        total = _psum(jnp.sum(state.y, axis=0), axis_name)
-        count = _psum(jnp.asarray(state.y.shape[0], state.y.dtype), axis_name)
-    else:
-        w = valid.astype(state.y.dtype)
-        total = _psum(jnp.sum(state.y * w[:, None], axis=0), axis_name)
-        count = _psum(jnp.sum(w), axis_name)
-    return state._replace(y=state.y - total / count)
+    return state._replace(
+        y=state.y - _global_mean(state.y, axis_name, valid))
+
+
+def center_input(x: jnp.ndarray, axis_name=None, valid=None) -> jnp.ndarray:
+    """Subtract the global mean from an input point set.
+
+    Parity with the reference's ``centerInput`` (TsneHelpers.scala:331-339) —
+    dead code there (never called, SURVEY §2.1), but part of its public step
+    API, and actually useful here as a pre-kNN whitening step."""
+    return x - _global_mean(x, axis_name, valid)
 
 
 def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,

@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", default=None)
     p.add_argument("--profile", default=None,
                    help="jax.profiler trace directory")
+    # multi-host bring-up (jax.distributed over DCN — the analog of the
+    # reference's Akka/Netty runtime, SURVEY §5); all three must be given
+    # on every process of the job, or none
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (jax.distributed.initialize)")
+    p.add_argument("--numProcesses", type=int, default=None)
+    p.add_argument("--processId", type=int, default=None)
     return p
 
 
@@ -83,10 +90,24 @@ def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2) -> st
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
 
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
+
+    multihost = (args.coordinator, args.numProcesses, args.processId)
+    if any(v is not None for v in multihost):
+        if any(v is None for v in multihost):
+            parser.error(
+                "--coordinator, --numProcesses and --processId must be given "
+                "together (on every process of the job) or not at all")
+        if args.numProcesses < 2:
+            parser.error(
+                "--numProcesses must be >= 2 for a multi-host job; drop the "
+                "multi-host flags entirely for single-process runs")
+        from tsne_flink_tpu.parallel.mesh import distributed_init
+        distributed_init(args.coordinator, args.numProcesses, args.processId)
 
     import jax
     import jax.numpy as jnp
