@@ -43,12 +43,17 @@ def _row_entropy(d, valid, beta, dtype):
     return h, p, sum_p
 
 
-def pairwise_affinities(dist: jnp.ndarray, perplexity: float) -> jnp.ndarray:
+def pairwise_affinities(dist: jnp.ndarray, perplexity: float,
+                        axis_name: str | None = None) -> jnp.ndarray:
     """Row-calibrated conditional affinities p_j|i.
 
     ``dist`` is the [N, k] kNN distance matrix (whatever metric produced it —
     the reference likewise feeds the raw kNN distances in).  Non-finite entries
     (padding of approximate kNN) are excluded from the search and get p = 0.
+
+    Row-parallel with no communication; pass ``axis_name`` when running on a
+    row shard inside ``shard_map`` (marks the bisection carry device-varying
+    for the vma type check — the values are identical either way).
 
     Returns [N, k] with each valid row summing to 1.
     """
@@ -77,6 +82,8 @@ def pairwise_affinities(dist: jnp.ndarray, perplexity: float) -> jnp.ndarray:
 
         init = (jnp.asarray(1.0, dtype), jnp.asarray(-jnp.inf, dtype),
                 jnp.asarray(jnp.inf, dtype), jnp.asarray(False))
+        if axis_name is not None:
+            init = tuple(lax.pcast(v, axis_name, to="varying") for v in init)
         beta, _, _, _ = lax.fori_loop(0, MAX_BISECT_STEPS, body, init)
         _, p, sum_p = _row_entropy(d_row, valid_row, beta, dtype)
         return p / sum_p

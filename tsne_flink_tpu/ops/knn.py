@@ -119,6 +119,28 @@ def knn_partition(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
             dist.reshape(-1, k)[:n])
 
 
+def merge_rounds(dists: list, idxs: list, k: int):
+    """Merge per-round (dist, idx) candidate sets: per-row sort by neighbor
+    id, mask adjacent duplicates, keep smallest-k — the regular-array form of
+    the reference's union / groupBy-dedup / re-rank
+    (``TsneHelpers.scala:113-133``).  Shared by the single-device and sharded
+    project kNN."""
+    if len(dists) == 1:
+        return idxs[0], dists[0]
+    n = dists[0].shape[0]
+    cat_d = jnp.concatenate(dists, axis=1)
+    cat_i = jnp.concatenate(idxs, axis=1)
+    order = jnp.argsort(cat_i, axis=1)
+    cat_i = jnp.take_along_axis(cat_i, order, axis=1)
+    cat_d = jnp.take_along_axis(cat_d, order, axis=1)
+    dup = jnp.concatenate([jnp.zeros((n, 1), bool),
+                           (cat_i[:, 1:] == cat_i[:, :-1])
+                           & jnp.isfinite(cat_d[:, 1:])], axis=1)
+    cat_d = jnp.where(dup, jnp.inf, cat_d)
+    dd, sel = _topk_smallest(cat_d, k)
+    return jnp.take_along_axis(cat_i, sel, axis=1), dd
+
+
 def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                 rounds: int = 3, key: jax.Array | None = None,
                 *, proj_dims: int = 3, block: int = 512):
@@ -215,21 +237,7 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
         dists.append(d)
         idxs.append(i)
 
-    if len(dists) == 1:
-        return idxs[0], dists[0]
-
-    # merge rounds: per-row sort by neighbor id, mark duplicates, smallest-k
-    cat_d = jnp.concatenate(dists, axis=1)
-    cat_i = jnp.concatenate(idxs, axis=1)
-    order = jnp.argsort(cat_i, axis=1)
-    cat_i = jnp.take_along_axis(cat_i, order, axis=1)
-    cat_d = jnp.take_along_axis(cat_d, order, axis=1)
-    dup = jnp.concatenate([jnp.zeros((n, 1), bool),
-                           (cat_i[:, 1:] == cat_i[:, :-1])
-                           & jnp.isfinite(cat_d[:, 1:])], axis=1)
-    cat_d = jnp.where(dup, jnp.inf, cat_d)
-    dd, sel = _topk_smallest(cat_d, k)
-    return jnp.take_along_axis(cat_i, sel, axis=1), dd
+    return merge_rounds(dists, idxs, k)
 
 
 def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",

@@ -1,0 +1,142 @@
+"""Whole-job SPMD: kNN -> affinities -> P -> optimize in ONE sharded program.
+
+The reference builds its entire pipeline as one lazy Flink dataflow and ships
+it to the cluster with a single ``env.execute()`` (``Tsne.scala:97``, SURVEY
+§3.1).  This module is the TPU equivalent: one ``shard_map``-ped, jitted
+function runs every stage on the device mesh with no host round-trips between
+stages — kNN over the ppermute ring (or the sharded Z-order path), the vmapped
+beta search on local rows, replicated-compute symmetrization, and the
+fori_loop optimizer, with all cross-stage arrays staying device-resident.
+
+Stage-to-communication map (vs SURVEY §2.2):
+
+==========================  =============================================
+reference shuffle            collective here
+==========================  =============================================
+cross / block-cross kNN      ``lax.ppermute`` ring (ring_knn)
+single-task Z-order sort     replicated Morton argsort (project_knn_sharded)
+groupBy(i) beta search       none — rows are mesh-local
+P + Pᵀ union/reduce shuffle  ``lax.all_gather`` of [N, k] idx/p + replicated
+                             sort/segment-sum, local row slice
+ΣP / Z / mean / loss reduce  ``lax.psum``
+full-embedding broadcast     ``lax.all_gather`` of [N, m] per iteration
+==========================  =============================================
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+from tsne_flink_tpu.parallel.knn import project_knn_sharded, ring_knn
+from tsne_flink_tpu.parallel.mesh import AXIS, make_mesh, pad_rows
+
+
+class SpmdPipeline:
+    """End-to-end sharded t-SNE: ``__call__(x, key)`` -> (embedding, losses).
+
+    ``knn_method`` follows the reference dispatch (``Tsne.scala:74-79``):
+    ``bruteforce`` and ``partition`` both lower to the exact ppermute ring
+    (identical results; the ring hop IS the block schedule), ``project`` to
+    the sharded Morton-band path.
+    """
+
+    def __init__(self, cfg: TsneConfig, n: int, dim: int, k: int,
+                 knn_method: str = "bruteforce", knn_rounds: int = 3,
+                 sym_width: int | None = None,
+                 n_devices: int | None = None):
+        self.cfg = cfg
+        self.n = n
+        self.k = int(min(k, n - 1))
+        self.knn_method = knn_method
+        self.knn_rounds = knn_rounds
+        self.mesh = make_mesh(n_devices)
+        self.n_devices = self.mesh.devices.size
+        d = self.n_devices
+        self.n_padded = math.ceil(n / d) * d
+        self.n_local = self.n_padded // d
+        # static symmetrized row width: out-degree k + in-degree headroom;
+        # overflow rows drop their largest-id entries with exact renorm
+        # (joint_distribution docstring)
+        self.sym_width = (int(sym_width) if sym_width is not None
+                          else max(8, (2 * self.k + 7) // 8 * 8))
+        self._compiled = None
+
+    def _local_fn(self, x_local, valid, key, start_iter, loss_carry):
+        cfg = self.cfg
+        me = lax.axis_index(AXIS)
+        row_offset = me * self.n_local
+
+        if self.knn_method in ("bruteforce", "partition"):
+            idx, dist = ring_knn(x_local, self.k, self.n_devices, self.n,
+                                 cfg.metric, axis_name=AXIS,
+                                 row_chunk=cfg.row_chunk)
+        elif self.knn_method == "project":
+            kkey = jax.random.fold_in(key, 1)
+            idx, dist = project_knn_sharded(
+                x_local, self.k, self.n_devices, self.n, cfg.metric,
+                rounds=self.knn_rounds, key=kkey, axis_name=AXIS)
+        else:
+            raise ValueError(f"Knn method '{self.knn_method}' not defined")
+
+        # padding rows must contribute no affinity mass
+        dist = jnp.where(valid[:, None], dist, jnp.inf)
+        p_cond = pairwise_affinities(dist, cfg.perplexity, axis_name=AXIS)
+
+        # symmetrization: gather the [N, k] graph, do the (deterministic)
+        # sort/segment-sum replicated, keep my row slice
+        idx_g = lax.all_gather(idx, AXIS, tiled=True)
+        p_g = lax.all_gather(p_cond, AXIS, tiled=True)
+        jidx_f, jval_f = joint_distribution(idx_g, p_g, self.sym_width)
+        jidx = lax.dynamic_slice_in_dim(jidx_f, row_offset, self.n_local)
+        jval = lax.dynamic_slice_in_dim(jval_f, row_offset, self.n_local)
+
+        # init y from the GLOBAL key so the embedding is device-count-invariant
+        ikey = jax.random.fold_in(key, 2)
+        y_full = (1e-4 * jax.random.normal(
+            ikey, (self.n_padded, cfg.n_components))).astype(x_local.dtype)
+        y = lax.dynamic_slice_in_dim(y_full, row_offset, self.n_local)
+        state = TsneState(y=y, update=jnp.zeros_like(y),
+                          gains=jnp.ones_like(y))
+
+        state, losses = optimize(state, jidx, jval, cfg, axis_name=AXIS,
+                                 row_offset=row_offset, valid=valid,
+                                 start_iter=start_iter,
+                                 loss_carry=loss_carry)
+        return state.y, losses
+
+    def _fn(self):
+        if self._compiled is None:
+            pspec = P(AXIS)
+            self._compiled = jax.jit(jax.shard_map(
+                self._local_fn, mesh=self.mesh,
+                in_specs=(pspec, pspec, P(), P(), P()),
+                out_specs=(pspec, P())))
+        return self._compiled
+
+    def _pad(self, x):
+        npad = self.n_padded - self.n
+        xp = pad_rows(jnp.asarray(x), npad)
+        valid = jnp.arange(self.n_padded) < self.n
+        return xp, valid
+
+    def lower(self, x, key):
+        xp, valid = self._pad(x)
+        return self._fn().lower(xp, valid, key, jnp.int32(0), self._loss0(xp.dtype))
+
+    def _loss0(self, dtype):
+        return jnp.zeros((max(self.cfg.n_loss_slots, 1),), dtype)
+
+    def __call__(self, x, key):
+        xp, valid = self._pad(x)
+        y, losses = self._fn()(xp, valid, key, jnp.int32(0),
+                               self._loss0(xp.dtype))
+        return y[: self.n], losses

@@ -58,6 +58,16 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "float64", "bfloat16"])
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size over the point axis (default: all)")
+    p.add_argument("--symWidth", type=int, default=None,
+                   help="(--spmd only) static symmetrized P-row width; "
+                        "default 2*neighbors. Rows whose symmetrized degree "
+                        "exceeds it drop their largest-id entries (with exact "
+                        "renormalization) — raise it for hub-heavy kNN graphs")
+    p.add_argument("--spmd", action="store_true",
+                   help="run the WHOLE pipeline (kNN, affinities, optimize) "
+                        "as one sharded program on the mesh — kNN over the "
+                        "ppermute ring / sharded Morton bands instead of "
+                        "single-device; required once N outgrows one chip")
     p.add_argument("--checkpoint", default=None,
                    help="path prefix for periodic (y, update, gains, iter) "
                         "checkpoints — capability-add over the reference")
@@ -125,6 +135,9 @@ def main(argv=None) -> int:
                  else 3 * int(args.perplexity))
 
     if args.inputDistanceMatrix:
+        if args.spmd:
+            parser.error("--spmd starts from raw points; it cannot be "
+                         "combined with --inputDistanceMatrix")
         ids, idx, dist = tio.read_distance_matrix(args.input)
         idx = jnp.asarray(idx)
         dist = jnp.asarray(dist, dtype)
@@ -134,11 +147,12 @@ def main(argv=None) -> int:
         n = len(ids)
         x = jnp.asarray(x_np, dtype)
         key = jax.random.key(args.randomState)
-        idx, dist = jax.jit(
-            lambda xx: knn_dispatch(
-                xx, neighbors, args.knnMethod, args.metric,
-                blocks=args.knnBlocks or jax.device_count(),
-                rounds=args.knnIterations, key=key))(x)
+        if not args.spmd:
+            idx, dist = jax.jit(
+                lambda xx: knn_dispatch(
+                    xx, neighbors, args.knnMethod, args.metric,
+                    blocks=args.knnBlocks or jax.device_count(),
+                    rounds=args.knnIterations, key=key))(x)
 
     cfg = TsneConfig(
         n_components=args.nComponents,
@@ -154,6 +168,43 @@ def main(argv=None) -> int:
                                  args.nComponents),
         bh_gate=args.bhGate,
     )
+
+    if args.spmd:
+        # the whole job as ONE sharded program (SpmdPipeline docstring);
+        # checkpointing of the fused program is a host-staged-only feature
+        if args.resume or args.checkpoint:
+            parser.error("--spmd does not support --checkpoint/--resume yet; "
+                         "use the host-staged pipeline for those runs")
+        from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+        pipe = SpmdPipeline(cfg, n, args.dimension, neighbors,
+                            knn_method=args.knnMethod,
+                            knn_rounds=args.knnIterations,
+                            sym_width=args.symWidth,
+                            n_devices=args.devices)
+        if args.executionPlan:
+            lowered = pipe.lower(x, key)
+            plan = {
+                "program": "tsne_spmd_pipeline",
+                "backend": jax.default_backend(),
+                "devices": pipe.n_devices,
+                "stablehlo": lowered.as_text(),
+            }
+            with open("tsne_executionPlan.json", "w") as f:
+                json.dump(plan, f)
+            print("execution plan written to tsne_executionPlan.json")
+            return 0
+        if args.profile:
+            jax.profiler.start_trace(args.profile)
+        y, losses = pipe(x, key)
+        y.block_until_ready()
+        if args.profile:
+            jax.profiler.stop_trace()
+        tio.write_embedding(args.output, ids, np.asarray(y))
+        tio.write_loss(args.loss, np.asarray(losses))
+        print(f"embedded {n} points -> {args.output} "
+              f"({time.time() - t0:.2f}s total, spmd over "
+              f"{pipe.n_devices} device(s), backend={jax.default_backend()})")
+        return 0
 
     jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
 
