@@ -112,3 +112,40 @@ def test_spmd_pipeline_project_runs_end_to_end():
     assert y.shape == (n, 2)
     assert np.isfinite(np.asarray(y)).all()
     assert np.abs(np.asarray(y).mean(axis=0)).max() < 1e-9  # centered
+
+
+def test_spmd_checkpoint_resume_identical():
+    # fused one-shot, segmented-with-checkpoints, and resumed-from-checkpoint
+    # runs must produce the same trajectory (the host-staged path already
+    # guarantees this; --spmd routes through run_checkpointable)
+    n, d, k = 40, 6, 7
+    x = jnp.asarray(blobs(n, d, seed=9))
+    cfg = TsneConfig(iterations=14, repulsion="exact", row_chunk=8,
+                     perplexity=4.0)
+    key = jax.random.key(3)
+    pipe = SpmdPipeline(cfg, n, d, k, knn_method="bruteforce", n_devices=8)
+
+    y_fused, loss_fused = pipe(x, key)
+
+    saves = []
+    state_seg, loss_seg = pipe.run_checkpointable(
+        x, key, checkpoint_every=5,
+        checkpoint_cb=lambda st, it, ls: saves.append(
+            (jax.tree.map(np.asarray, st), it, np.asarray(ls))))
+    np.testing.assert_allclose(np.asarray(state_seg.y), np.asarray(y_fused),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(loss_seg), np.asarray(loss_fused),
+                               atol=1e-12)
+    assert [it for _, it, _ in saves] == [5, 10]
+
+    st_np, it_mid, loss_mid = saves[1]
+    resume_state = TsneState(y=jnp.asarray(st_np.y),
+                             update=jnp.asarray(st_np.update),
+                             gains=jnp.asarray(st_np.gains))
+    state_res, loss_res = pipe.run_checkpointable(
+        x, key, start_iter=it_mid, loss_carry=loss_mid,
+        resume_state=resume_state)
+    np.testing.assert_allclose(np.asarray(state_res.y),
+                               np.asarray(y_fused), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(loss_res), np.asarray(loss_fused),
+                               atol=1e-12)

@@ -69,8 +69,11 @@ class SpmdPipeline:
         self.sym_width = (int(sym_width) if sym_width is not None
                           else max(8, (2 * self.k + 7) // 8 * 8))
         self._compiled = None
+        self._prepared = None
+        self._runner = None
 
-    def _local_fn(self, x_local, valid, key, start_iter, loss_carry):
+    def _prepare_local(self, x_local, valid, key):
+        """kNN -> beta search -> symmetrized local P rows + initial state."""
         cfg = self.cfg
         me = lax.axis_index(AXIS)
         row_offset = me * self.n_local
@@ -106,9 +109,13 @@ class SpmdPipeline:
         y = lax.dynamic_slice_in_dim(y_full, row_offset, self.n_local)
         state = TsneState(y=y, update=jnp.zeros_like(y),
                           gains=jnp.ones_like(y))
+        return jidx, jval, state
 
-        state, losses = optimize(state, jidx, jval, cfg, axis_name=AXIS,
-                                 row_offset=row_offset, valid=valid,
+    def _local_fn(self, x_local, valid, key, start_iter, loss_carry):
+        jidx, jval, state = self._prepare_local(x_local, valid, key)
+        me = lax.axis_index(AXIS)
+        state, losses = optimize(state, jidx, jval, self.cfg, axis_name=AXIS,
+                                 row_offset=me * self.n_local, valid=valid,
                                  start_iter=start_iter,
                                  loss_carry=loss_carry)
         return state.y, losses
@@ -135,7 +142,58 @@ class SpmdPipeline:
     def _loss0(self, dtype):
         return jnp.zeros((max(self.cfg.n_loss_slots, 1),), dtype)
 
+    def prepare(self, x, key):
+        """Run only the data-prep half (kNN -> P rows -> initial state) as a
+        sharded program; returns UNPADDED global (jidx, jval, TsneState) for
+        the segmented / checkpointable optimizer path."""
+        if self._prepared is None:
+            pspec = P(AXIS)
+            state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
+            self._prepared = jax.jit(jax.shard_map(
+                self._prepare_local, mesh=self.mesh,
+                in_specs=(pspec, pspec, P()),
+                out_specs=(pspec, pspec, state_spec)))
+        xp, valid = self._pad(x)
+        jidx, jval, state = self._prepared(xp, valid, key)
+        n = self.n
+        return (jidx[:n], jval[:n],
+                TsneState(y=state.y[:n], update=state.update[:n],
+                          gains=state.gains[:n]))
+
+    def run_checkpointable(self, x, key, *, start_iter: int = 0,
+                           loss_carry=None, resume_state: TsneState | None = None,
+                           checkpoint_every: int = 0, checkpoint_cb=None):
+        """prepare() + the segmented ShardedOptimizer (same mesh): gives
+        --spmd runs the same checkpoint/resume semantics as the host-staged
+        pipeline, returning the full ``(TsneState, losses)``.
+
+        kNN/affinities are deterministic in (x, key, cfg), so a resumed run
+        recomputes P bit-identically; the optimizer state itself comes from
+        ``resume_state`` (the checkpoint), NOT from re-initialization.
+
+        Single-controller only: checkpointing fetches global arrays to the
+        host, which multi-process jobs cannot do — they get a clear error
+        here instead of an opaque crash mid-run."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "checkpoint/resume of --spmd runs is single-controller only "
+                "(global-array host fetch); run multi-host jobs without "
+                "checkpointing or use the host-staged pipeline")
+        from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+
+        jidx, jval, state = self.prepare(x, key)
+        if resume_state is not None:
+            state = resume_state
+        if self._runner is None:
+            self._runner = ShardedOptimizer(self.cfg, self.n,
+                                            n_devices=self.mesh.devices.size)
+        return self._runner(state, jidx, jval, start_iter=start_iter,
+                            loss_carry=loss_carry,
+                            checkpoint_every=checkpoint_every,
+                            checkpoint_cb=checkpoint_cb)
+
     def __call__(self, x, key):
+        """Fused fast path: the whole job in one compiled sharded program."""
         xp, valid = self._pad(x)
         y, losses = self._fn()(xp, valid, key, jnp.int32(0),
                                self._loss0(xp.dtype))

@@ -25,6 +25,7 @@ def save(path: str, state: TsneState, next_iter: int,
          losses: np.ndarray) -> None:
     """Atomic write (tmp + rename) so an interrupt never corrupts the file."""
     d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
     try:
         with os.fdopen(fd, "wb") as f:

@@ -99,6 +99,46 @@ def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2) -> st
     return "exact"
 
 
+def _load_resume(args, dtype):
+    """(start_iter, loss_carry, TsneState|None) from --resume, shared by the
+    host-staged and --spmd branches."""
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneState
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+
+    if not args.resume:
+        return 0, None, None
+    st_np, start_iter, loss_carry = ckpt.load(args.resume)
+    state = TsneState(y=jnp.asarray(st_np.y, dtype),
+                      update=jnp.asarray(st_np.update, dtype),
+                      gains=jnp.asarray(st_np.gains, dtype))
+    print(f"resumed from {args.resume} at iteration {start_iter}")
+    return start_iter, loss_carry, state
+
+
+def _make_checkpoint_cb(args):
+    """Periodic-checkpoint callback for --checkpoint/--checkpointEvery."""
+    if not (args.checkpoint and args.checkpointEvery > 0):
+        return None
+    import numpy as np
+
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+
+    def cb(st, next_iter, losses):
+        ckpt.save(args.checkpoint, st, next_iter, np.asarray(losses))
+    return cb
+
+
+def _save_final_checkpoint(args, state, iterations, losses):
+    if not args.checkpoint:
+        return
+    import numpy as np
+
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+    ckpt.save(args.checkpoint, state, iterations, np.asarray(losses))
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -170,11 +210,9 @@ def main(argv=None) -> int:
     )
 
     if args.spmd:
-        # the whole job as ONE sharded program (SpmdPipeline docstring);
-        # checkpointing of the fused program is a host-staged-only feature
-        if args.resume or args.checkpoint:
-            parser.error("--spmd does not support --checkpoint/--resume yet; "
-                         "use the host-staged pipeline for those runs")
+        # the whole job as ONE sharded program (SpmdPipeline); with
+        # --checkpoint/--resume it switches to the segmented prepare+optimize
+        # form with identical results
         from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
         pipe = SpmdPipeline(cfg, n, args.dimension, neighbors,
                             knn_method=args.knnMethod,
@@ -195,8 +233,19 @@ def main(argv=None) -> int:
             return 0
         if args.profile:
             jax.profiler.start_trace(args.profile)
-        y, losses = pipe(x, key)
-        y.block_until_ready()
+        if args.resume or args.checkpoint:
+            start_iter, loss_carry, resume_state = _load_resume(args, dtype)
+            state, losses = pipe.run_checkpointable(
+                x, key, start_iter=start_iter, loss_carry=loss_carry,
+                resume_state=resume_state,
+                checkpoint_every=args.checkpointEvery,
+                checkpoint_cb=_make_checkpoint_cb(args))
+            y = state.y
+            y.block_until_ready()
+            _save_final_checkpoint(args, state, cfg.iterations, losses)
+        else:
+            y, losses = pipe(x, key)
+            y.block_until_ready()
         if args.profile:
             jax.profiler.stop_trace()
         tio.write_embedding(args.output, ids, np.asarray(y))
@@ -208,17 +257,8 @@ def main(argv=None) -> int:
 
     jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
 
-    start_iter = 0
-    loss_carry = None
-    if args.resume:
-        from tsne_flink_tpu.models.tsne import TsneState
-        from tsne_flink_tpu.utils import checkpoint as ckpt
-        st_np, start_iter, loss_carry = ckpt.load(args.resume)
-        state = TsneState(y=jnp.asarray(st_np.y, dtype),
-                          update=jnp.asarray(st_np.update, dtype),
-                          gains=jnp.asarray(st_np.gains, dtype))
-        print(f"resumed from {args.resume} at iteration {start_iter}")
-    else:
+    start_iter, loss_carry, state = _load_resume(args, dtype)
+    if state is None:
         state = init_working_set(jax.random.key(args.randomState), n,
                                  cfg.n_components, dtype)
 
@@ -238,27 +278,16 @@ def main(argv=None) -> int:
         print("execution plan written to tsne_executionPlan.json")
         return 0
 
-    checkpoint_cb = None
-    if args.checkpoint and args.checkpointEvery > 0:
-        import numpy as _np
-
-        from tsne_flink_tpu.utils import checkpoint as ckpt
-
-        def checkpoint_cb(st, next_iter, losses):
-            ckpt.save(args.checkpoint, st, next_iter, _np.asarray(losses))
-
     if args.profile:
         jax.profiler.start_trace(args.profile)
     state, losses = runner(state, jidx, jval, start_iter=start_iter,
                            loss_carry=loss_carry,
                            checkpoint_every=args.checkpointEvery,
-                           checkpoint_cb=checkpoint_cb)
+                           checkpoint_cb=_make_checkpoint_cb(args))
     state.y.block_until_ready()
     if args.profile:
         jax.profiler.stop_trace()
-    if args.checkpoint:
-        from tsne_flink_tpu.utils import checkpoint as ckpt
-        ckpt.save(args.checkpoint, state, cfg.iterations, np.asarray(losses))
+    _save_final_checkpoint(args, state, cfg.iterations, losses)
 
     tio.write_embedding(args.output, ids, np.asarray(state.y[:n]))
     tio.write_loss(args.loss, np.asarray(losses))
