@@ -16,6 +16,7 @@ iterations.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -30,9 +31,39 @@ def make_data(n=60_000, d=784, classes=10, seed=0):
     return np.clip(x, 0.0, 1.0)
 
 
+def _backend_watchdog(timeout_s: float):
+    """Fail fast (instead of hanging past the driver's patience) if the TPU
+    tunnel cannot even initialize: backend bring-up normally takes seconds;
+    a wedged tunnel blocks jax.devices() indefinitely."""
+    import threading
+
+    done = threading.Event()
+    err: list = []
+
+    def probe():
+        try:
+            import jax
+            jax.devices()
+        except BaseException as e:  # surfaced in the main thread below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        print(f"# backend init did not complete within {timeout_s:.0f}s — "
+              "accelerator tunnel unavailable", file=sys.stderr)
+        os._exit(3)
+    if err:
+        raise err[0]
+
+
 def main():
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
+
+    _backend_watchdog(float(os.environ.get("TSNE_BENCH_INIT_TIMEOUT", "300")))
 
     import jax
     import jax.numpy as jnp
