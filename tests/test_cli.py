@@ -169,3 +169,24 @@ def test_cli_execution_plan(tmp_path, monkeypatch):
         plan = json.load(f)
     assert "stablehlo" in plan and len(plan["stablehlo"]) > 100
     assert not os.path.exists(os.path.join(tmp, "o.csv"))  # plan only, no exec
+
+
+def test_estimator_api_fit_transform():
+    # sklearn-style surface: TSNE(...).fit_transform, embedding_/kl attrs
+    import numpy as np
+
+    from tsne_flink_tpu import TSNE
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(3, 8)) * 5.0
+    x = centers[rng.integers(0, 3, 60)] + rng.normal(size=(60, 8))
+    est = TSNE(perplexity=5.0, n_iter=60, random_state=4, knn_method="partition")
+    y = est.fit_transform(x)
+    assert y.shape == (60, 2)
+    assert np.isfinite(y).all()
+    assert np.isfinite(est.kl_divergence_)
+    assert est.kl_trace_.shape == (6,)
+    # determinism in random_state
+    y2 = TSNE(perplexity=5.0, n_iter=60, random_state=4,
+              knn_method="partition").fit_transform(x)
+    np.testing.assert_array_equal(y, y2)

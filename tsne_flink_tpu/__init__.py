@@ -31,5 +31,6 @@ from tsne_flink_tpu.ops.affinities import (  # noqa: F401
     pairwise_affinities,
     joint_distribution,
 )
+from tsne_flink_tpu.models.api import TSNE  # noqa: F401
 
 __version__ = "0.1.0"

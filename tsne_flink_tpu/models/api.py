@@ -1,0 +1,83 @@
+"""Estimator-style user API: ``TSNE(...).fit_transform(X)``.
+
+The reference exposes only a CLI (``Tsne.scala:33``) and raw step functions;
+this wrapper is the in-process equivalent of its `computeEmbedding` pipeline
+(``Tsne.scala:105-136``) with the familiar scikit-learn surface, so library
+users do not have to shell out.  Hyper-parameter names follow the CLI /
+reference flag table (``Tsne.scala:39-63``); scikit-learn spellings are
+accepted where they differ (``n_iter``, ``random_state``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tsne_flink_tpu.models.tsne import TsneConfig, tsne_embed
+
+
+class TSNE:
+    """t-SNE estimator running on whatever JAX backend is active (TPU/CPU).
+
+    Parameters mirror :class:`TsneConfig` plus the kNN stage controls; after
+    :meth:`fit`, the results are in ``embedding_``, ``kl_divergence_`` (final
+    recorded KL) and ``kl_trace_`` (every 10th iteration, the reference's loss
+    accumulator).
+    """
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 early_exaggeration: float = 4.0, learning_rate: float = 1000.0,
+                 n_iter: int = 300, metric: str = "sqeuclidean",
+                 initial_momentum: float = 0.5, final_momentum: float = 0.8,
+                 theta: float = 0.25, repulsion: str = "auto",
+                 knn_method: str = "bruteforce", neighbors: int | None = None,
+                 knn_blocks: int = 8, knn_iterations: int = 3,
+                 random_state: int = 0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.early_exaggeration = early_exaggeration
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.metric = metric
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.theta = theta
+        self.repulsion = repulsion
+        self.knn_method = knn_method
+        self.neighbors = neighbors
+        self.knn_blocks = knn_blocks
+        self.knn_iterations = knn_iterations
+        self.random_state = random_state
+        self.embedding_ = None
+        self.kl_divergence_ = None
+        self.kl_trace_ = None
+
+    def _config(self, n: int) -> TsneConfig:
+        from tsne_flink_tpu.utils.cli import pick_repulsion
+
+        return TsneConfig(
+            n_components=self.n_components, perplexity=self.perplexity,
+            early_exaggeration=self.early_exaggeration,
+            learning_rate=self.learning_rate, iterations=self.n_iter,
+            initial_momentum=self.initial_momentum,
+            final_momentum=self.final_momentum, theta=self.theta,
+            metric=self.metric,
+            repulsion=pick_repulsion(self.repulsion, self.theta, n,
+                                     self.n_components))
+
+    def fit(self, x, y=None) -> "TSNE":
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        cfg = self._config(x.shape[0])
+        y, losses = tsne_embed(
+            x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
+            knn_blocks=self.knn_blocks, knn_iterations=self.knn_iterations,
+            seed=self.random_state)
+        self.embedding_ = np.asarray(y)
+        self.kl_trace_ = np.asarray(losses)
+        self.kl_divergence_ = (float(self.kl_trace_[-1])
+                               if self.kl_trace_.size else float("nan"))
+        return self
+
+    def fit_transform(self, x, y=None) -> np.ndarray:
+        return self.fit(x).embedding_
