@@ -1,0 +1,63 @@
+"""Embedding-quality validation against scikit-learn's t-SNE.
+
+BASELINE.md's acceptance bar is "cuML-equivalent final KL"; with no GPU in
+the image, sklearn.manifold.TSNE (same Barnes-Hut lineage) is the available
+independent yardstick.  Compares, on the same blobs dataset:
+
+* final KL divergence (both optimizers report it)
+* trustworthiness (sklearn.manifold.trustworthiness, k=12) — the standard
+  neighborhood-preservation score in [0, 1]
+
+Usage: python scripts/validate_quality.py [n] [dim] [repulsion]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1500
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    repulsion = sys.argv[3] if len(sys.argv) > 3 else "exact"
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(8, d)) * 6.0
+    labels = rng.integers(0, 8, n)
+    x = (centers[labels] + rng.normal(size=(n, d))).astype(np.float32)
+
+    from sklearn.manifold import TSNE as SkTSNE, trustworthiness
+
+    t0 = time.time()
+    sk = SkTSNE(n_components=2, perplexity=30.0, early_exaggeration=4.0,
+                learning_rate=1000.0, init="random", random_state=0,
+                max_iter=1000)
+    y_sk = sk.fit_transform(x)
+    t_sk = time.time() - t0
+
+    from tsne_flink_tpu import TSNE
+
+    t0 = time.time()
+    ours = TSNE(perplexity=30.0, n_iter=1000, repulsion=repulsion,
+                knn_method="bruteforce", random_state=0)
+    y_us = ours.fit_transform(x)
+    t_us = time.time() - t0
+
+    tw_sk = trustworthiness(x, y_sk, n_neighbors=12)
+    tw_us = trustworthiness(x, y_us, n_neighbors=12)
+
+    print(f"n={n} d={d} repulsion={repulsion}")
+    print(f"sklearn : KL={sk.kl_divergence_:.4f}  trustworthiness={tw_sk:.4f}"
+          f"  ({t_sk:.1f}s)")
+    print(f"ours    : KL={ours.kl_divergence_:.4f}  "
+          f"trustworthiness={tw_us:.4f}  ({t_us:.1f}s)")
+    print("note: KL values are not directly comparable across implementations"
+          " (different affinity supports: dense vs kNN-sparse); "
+          "trustworthiness is.")
+
+
+if __name__ == "__main__":
+    main()
