@@ -11,12 +11,21 @@ independent yardstick.  Compares, on the same blobs dataset:
 Usage: python scripts/validate_quality.py [n] [dim] [repulsion]
 """
 
+import os
 import sys
 import time
 
 sys.path.insert(0, ".")
 
 import numpy as np
+
+# run the comparison on CPU (the README table is CPU f32, and sklearn is
+# CPU anyway); sitecustomize latches JAX_PLATFORMS, so pin via jax.config.
+# Set TSNE_QUALITY_BACKEND=tpu to measure the accelerator path instead.
+import jax
+
+jax.config.update("jax_platforms",
+                  os.environ.get("TSNE_QUALITY_BACKEND", "cpu"))
 
 
 def main():

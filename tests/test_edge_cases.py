@@ -1,0 +1,62 @@
+"""Degenerate-input robustness: duplicate points, zero-distance rows,
+constant features.  The reference guards the zero-sum entropy case with 1e-7
+(``TsneHelpers.scala:490-495``); these tests pin the same behaviors
+end-to-end."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.models.tsne import TsneConfig, tsne_embed
+from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
+from tsne_flink_tpu.ops.knn import knn_bruteforce
+
+
+def test_duplicate_points_zero_distances():
+    # 10 copies of one point among 30: kNN rows full of d=0; beta search must
+    # not NaN (zero-sum guard) and the pipeline must stay finite
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(30, 5))
+    x[10:20] = x[5]
+    idx, dist = knn_bruteforce(jnp.asarray(x), 6)
+    assert float(dist.min()) == 0.0
+    p = pairwise_affinities(dist, 4.0)
+    assert np.isfinite(np.asarray(p)).all()
+    jidx, jval = joint_distribution(idx, p)
+    assert np.isfinite(np.asarray(jval)).all()
+    np.testing.assert_allclose(float(jnp.sum(jval)), 1.0, rtol=1e-9)
+    y, losses = tsne_embed(jnp.asarray(x), TsneConfig(
+        iterations=20, repulsion="exact", perplexity=4.0), neighbors=6)
+    assert np.isfinite(np.asarray(y)).all()
+    assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_all_identical_points_do_not_nan():
+    # pathological: EVERY point identical — entropy sum is degenerate in every
+    # row; embedding must remain finite (repulsion spreads the copies)
+    x = jnp.ones((16, 4), jnp.float64)
+    y, losses = tsne_embed(x, TsneConfig(
+        iterations=15, repulsion="exact", perplexity=3.0), neighbors=4)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_constant_feature_and_single_cluster():
+    # a constant column (zero variance) must not break any metric path
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(40, 6))
+    x[:, 2] = 7.0
+    for metric in ("sqeuclidean", "euclidean", "cosine"):
+        idx, dist = knn_bruteforce(jnp.asarray(x), 5, metric)
+        assert np.isfinite(np.asarray(dist)).all(), metric
+
+
+def test_k_larger_than_n_is_clamped():
+    # reference's first(k) silently shortens groups (TsneHelpers.scala:58);
+    # here k clamps to n-1 and the pipeline still runs
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(7, 3))
+    idx, dist = knn_bruteforce(jnp.asarray(x), 50)
+    assert idx.shape == (7, 6)
+    y, _ = tsne_embed(jnp.asarray(x), TsneConfig(
+        iterations=10, repulsion="exact", perplexity=2.0), neighbors=50)
+    assert np.isfinite(np.asarray(y)).all()
