@@ -1,0 +1,93 @@
+"""Multi-process (DCN-analog) validation: the SAME SpmdPipeline program runs
+across 2 jax.distributed processes x 4 CPU devices each, and must match the
+single-process 8-device run — the reference's Flink-cluster behavior
+(multiple task managers) pinned by an actual multi-controller execution,
+not just a mesh simulation."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.models.tsne import TsneConfig
+from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+
+N, DIM, K = 44, 6, 8
+
+
+def mp_problem():
+    """Shared dataset + config for the worker and the in-test reference run."""
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, DIM)) * 5.0
+    x = centers[rng.integers(0, 4, N)] + rng.normal(size=(N, DIM))
+    cfg = TsneConfig(iterations=10, repulsion="exact", row_chunk=8,
+                     perplexity=4.0)
+    return x, cfg
+
+
+_WORKER = r"""
+import os, sys
+pid, nproc, port, out, tests_dir = (int(sys.argv[1]), int(sys.argv[2]),
+                                    sys.argv[3], sys.argv[4], sys.argv[5])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.distributed.initialize(f"127.0.0.1:{port}", nproc, pid)
+assert jax.process_count() == nproc and jax.device_count() == 4 * nproc
+import numpy as np, jax.numpy as jnp
+from jax.experimental import multihost_utils
+sys.path.insert(0, tests_dir)
+from test_multiprocess import N, DIM, K, mp_problem
+from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+
+x, cfg = mp_problem()
+pipe = SpmdPipeline(cfg, N, DIM, K, knn_method="bruteforce")
+y, losses = pipe(jnp.asarray(x), jax.random.key(7))
+y_full = np.asarray(multihost_utils.process_allgather(y, tiled=True))[:N]
+if pid == 0:
+    np.save(out, y_full)
+    np.save(out + ".loss.npy", np.asarray(losses))
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_matches_single_process(tmp_path):
+    out = str(tmp_path / "y_mp.npy")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.getcwd(), env.get("PYTHONPATH", "")])
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    port = str(_free_port())
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, str(pid), "2", port, out, tests_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(2)]
+    try:
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o.decode()[-2000:]
+
+    x, cfg = mp_problem()
+    pipe = SpmdPipeline(cfg, N, DIM, K, knn_method="bruteforce", n_devices=8)
+    y1, losses1 = pipe(jnp.asarray(x), jax.random.key(7))
+
+    y_mp = np.load(out)
+    np.testing.assert_allclose(y_mp, np.asarray(y1), atol=1e-9)
+    loss_mp = np.load(out + ".loss.npy")
+    np.testing.assert_allclose(loss_mp, np.asarray(losses1), atol=1e-9)

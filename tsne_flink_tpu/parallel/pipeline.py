@@ -72,8 +72,11 @@ class SpmdPipeline:
         self._prepared = None
         self._runner = None
 
-    def _prepare_local(self, x_local, valid, key):
+    def _prepare_local(self, x_local, valid, key_data):
         """kNN -> beta search -> symmetrized local P rows + initial state."""
+        # the PRNG key travels as raw key_data (uint32) so multi-process runs
+        # can pass it as a plain replicated array
+        key = jax.random.wrap_key_data(key_data)
         cfg = self.cfg
         me = lax.axis_index(AXIS)
         row_offset = me * self.n_local
@@ -111,8 +114,8 @@ class SpmdPipeline:
                           gains=jnp.ones_like(y))
         return jidx, jval, state
 
-    def _local_fn(self, x_local, valid, key, start_iter, loss_carry):
-        jidx, jval, state = self._prepare_local(x_local, valid, key)
+    def _local_fn(self, x_local, valid, key_data, start_iter, loss_carry):
+        jidx, jval, state = self._prepare_local(x_local, valid, key_data)
         me = lax.axis_index(AXIS)
         state, losses = optimize(state, jidx, jval, self.cfg, axis_name=AXIS,
                                  row_offset=me * self.n_local, valid=valid,
@@ -129,15 +132,36 @@ class SpmdPipeline:
                 out_specs=(pspec, P())))
         return self._compiled
 
+    def _globalize(self, arr_np, spec):
+        """Host-local numpy -> global jax.Array over this pipeline's mesh
+        (multi-process only).  Every process serves its addressable shards
+        from its local copy via ``make_array_from_callback`` — each host must
+        hold (at least) the rows its devices own; holding the full array is
+        fine and is what the 2-process tests do."""
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(
+            arr_np.shape, sharding, lambda idx: np.asarray(arr_np[idx]))
+
     def _pad(self, x):
         npad = self.n_padded - self.n
-        xp = pad_rows(jnp.asarray(x), npad)
-        valid = jnp.arange(self.n_padded) < self.n
-        return xp, valid
+        if jax.process_count() == 1:  # device-side pad, no host round-trip
+            xp = pad_rows(jnp.asarray(x), npad)
+            valid = jnp.arange(self.n_padded) < self.n
+            return xp, valid
+        xp = np.pad(np.asarray(x), ((0, npad), (0, 0)))
+        valid = np.arange(self.n_padded) < self.n
+        return (self._globalize(xp, P(AXIS)), self._globalize(valid, P(AXIS)))
+
+    @staticmethod
+    def _key_data(key):
+        return jnp.asarray(jax.random.key_data(key))
 
     def lower(self, x, key):
         xp, valid = self._pad(x)
-        return self._fn().lower(xp, valid, key, jnp.int32(0), self._loss0(xp.dtype))
+        return self._fn().lower(xp, valid, self._key_data(key), jnp.int32(0),
+                                self._loss0(xp.dtype))
 
     def _loss0(self, dtype):
         return jnp.zeros((max(self.cfg.n_loss_slots, 1),), dtype)
@@ -154,7 +178,7 @@ class SpmdPipeline:
                 in_specs=(pspec, pspec, P()),
                 out_specs=(pspec, pspec, state_spec)))
         xp, valid = self._pad(x)
-        jidx, jval, state = self._prepared(xp, valid, key)
+        jidx, jval, state = self._prepared(xp, valid, self._key_data(key))
         n = self.n
         return (jidx[:n], jval[:n],
                 TsneState(y=state.y[:n], update=state.update[:n],
@@ -184,6 +208,7 @@ class SpmdPipeline:
         jidx, jval, state = self.prepare(x, key)
         if resume_state is not None:
             state = resume_state
+
         if self._runner is None:
             self._runner = ShardedOptimizer(self.cfg, self.n,
                                             n_devices=self.mesh.devices.size)
@@ -193,8 +218,16 @@ class SpmdPipeline:
                             checkpoint_cb=checkpoint_cb)
 
     def __call__(self, x, key):
-        """Fused fast path: the whole job in one compiled sharded program."""
+        """Fused fast path: the whole job in one compiled sharded program.
+
+        Single-process: returns ``(y [n, m], losses)``.  Multi-process
+        (``jax.distributed``): returns the PADDED global ``y [n_padded, m]``
+        (host-side slicing of a non-addressable array is impossible); fetch
+        with ``jax.experimental.multihost_utils.process_allgather`` and slice
+        to ``pipe.n``, as the CLI does."""
         xp, valid = self._pad(x)
-        y, losses = self._fn()(xp, valid, key, jnp.int32(0),
+        y, losses = self._fn()(xp, valid, self._key_data(key), jnp.int32(0),
                                self._loss0(xp.dtype))
+        if jax.process_count() > 1:
+            return y, losses
         return y[: self.n], losses

@@ -248,8 +248,19 @@ def main(argv=None) -> int:
             y.block_until_ready()
         if args.profile:
             jax.profiler.stop_trace()
-        tio.write_embedding(args.output, ids, np.asarray(y))
-        tio.write_loss(args.loss, np.asarray(losses))
+        if jax.process_count() > 1:
+            # fetch the global embedding everywhere; only process 0 writes
+            from jax.experimental import multihost_utils
+            y_np = np.asarray(multihost_utils.process_allgather(
+                y, tiled=True))[:n]
+            losses_np = np.asarray(losses)
+            if jax.process_index() != 0:
+                return 0
+        else:
+            y_np = np.asarray(y)[:n]
+            losses_np = np.asarray(losses)
+        tio.write_embedding(args.output, ids, y_np)
+        tio.write_loss(args.loss, losses_np)
         print(f"embedded {n} points -> {args.output} "
               f"({time.time() - t0:.2f}s total, spmd over "
               f"{pipe.n_devices} device(s), backend={jax.default_backend()})")
