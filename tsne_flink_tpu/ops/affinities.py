@@ -122,6 +122,53 @@ def symmetrized_width(idx: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(8, (max_deg + 7) // 8 * 8)
 
 
+def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
+                  n_rows: int, sym_width: int | None = None):
+    """COO edge lists -> padded per-row layout, merging duplicate (i, j).
+
+    ``ii`` (target row, with ``ii == n_rows`` marking invalid entries), ``jj``
+    (neighbor id), ``vv`` (value) are flat arrays of equal length.  Returns
+    ``(jidx [n_rows, S], jval [n_rows, S])`` UN-normalized, rows sorted by
+    neighbor id, padded with (0, 0.0).  This is the shared core of the
+    replicated :func:`joint_distribution` and the routed (all_to_all)
+    distributed symmetrization — the reference's ``groupBy(j,i).reduce(+)``
+    shuffle (TsneHelpers.scala:188) in one ``lax.sort`` + segment-sum.
+
+    With ``sym_width=None`` S is sized to the true max row degree (host sync;
+    preprocessing only).  If an explicit width is exceeded, the largest-id
+    entries of the overflowing row are dropped.
+    """
+    dtype = vv.dtype
+    ii, jj, vv = lax.sort((ii, jj, vv), num_keys=2)
+    e = ii.shape[0]
+
+    # run-length merge of duplicate (i, j)
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             (ii[1:] != ii[:-1]) | (jj[1:] != jj[:-1])])
+    run = jnp.cumsum(first) - 1
+    run_sum = jax.ops.segment_sum(vv, run, num_segments=e)
+    run_sum_at_entry = run_sum[run]
+
+    # column slot of each run within its row
+    row_first = jnp.concatenate([jnp.ones((1,), bool), ii[1:] != ii[:-1]])
+    row_start_run = lax.cummax(jnp.where(row_first, run, 0))
+    col = run - row_start_run
+
+    if sym_width is not None:
+        s = int(sym_width)
+    else:
+        max_deg = int(jnp.max(jnp.where(first & (ii < n_rows), col, -1))) + 1
+        s = max(8, -(-max_deg // 8) * 8)
+
+    keep = first & (col < s) & (ii < n_rows)
+    scat_row = jnp.where(keep, ii, n_rows)  # dump row
+    jidx = jnp.zeros((n_rows + 1, s), jnp.int32).at[scat_row, col].set(
+        jj.astype(jnp.int32), mode="drop")[:n_rows]
+    jval = jnp.zeros((n_rows + 1, s), dtype).at[scat_row, col].set(
+        jnp.where(keep, run_sum_at_entry, 0.0), mode="drop")[:n_rows]
+    return jidx, jval
+
+
 def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
                        sym_width: int | None = None):
     """Symmetrize + globally normalize: P_ij = (p_j|i + p_i|j) / ΣP.
@@ -149,40 +196,13 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
     present = p > 0
 
     # forward + transposed edge lists; absent edges get row id n (sorts last,
-    # lands in the dump row of the scatter below)
+    # lands in the dump row of the scatter inside assemble_rows)
     ii = jnp.concatenate([jnp.where(present, rows, n).reshape(-1),
                           jnp.where(present, cols, n).reshape(-1)])
     jj = jnp.concatenate([cols.reshape(-1), rows.reshape(-1)])
     vv = jnp.concatenate([p.reshape(-1), p.reshape(-1)])
 
-    ii, jj, vv = lax.sort((ii, jj, vv), num_keys=2)
-    e = ii.shape[0]
-
-    # run-length merge of duplicate (i, j): the reference's groupBy(0,1).reduce(+)
-    first = jnp.concatenate([jnp.ones((1,), bool),
-                             (ii[1:] != ii[:-1]) | (jj[1:] != jj[:-1])])
-    run = jnp.cumsum(first) - 1  # run id per entry
-    run_sum = jax.ops.segment_sum(vv, run, num_segments=e)  # at run ordinal
-    run_sum_at_entry = run_sum[run]
-
-    # column slot of each run within its row = run ordinal - first run ordinal of row
-    row_first = jnp.concatenate([jnp.ones((1,), bool), ii[1:] != ii[:-1]])
-    row_start_run = lax.cummax(jnp.where(row_first, run, 0))
-    col = run - row_start_run
-
-    if sym_width is not None:
-        s = int(sym_width)
-    else:
-        # size to the true max row degree (concrete -> host sync; preprocessing)
-        max_deg = int(jnp.max(jnp.where(first & (ii < n), col, -1))) + 1
-        s = max(8, -(-max_deg // 8) * 8)
-
-    keep = first & (col < s) & (ii < n)
-    scat_row = jnp.where(keep, ii, n)  # dump row n
-    jidx = jnp.zeros((n + 1, s), jnp.int32).at[scat_row, col].set(
-        jj, mode="drop")[:n]
-    jval = jnp.zeros((n + 1, s), dtype).at[scat_row, col].set(
-        jnp.where(keep, run_sum_at_entry, 0.0), mode="drop")[:n]
+    jidx, jval = assemble_rows(ii, jj, vv, n, sym_width)
 
     sum_p = jnp.sum(jval)
     valid = jval > 0

@@ -51,13 +51,17 @@ class SpmdPipeline:
 
     def __init__(self, cfg: TsneConfig, n: int, dim: int, k: int,
                  knn_method: str = "bruteforce", knn_rounds: int = 3,
-                 sym_width: int | None = None,
-                 n_devices: int | None = None):
+                 sym_width: int | None = None, sym_mode: str = "replicated",
+                 sym_slack: int = 4, n_devices: int | None = None):
+        if sym_mode not in ("replicated", "alltoall"):
+            raise ValueError(f"sym_mode '{sym_mode}' not defined")
         self.cfg = cfg
         self.n = n
         self.k = int(min(k, n - 1))
         self.knn_method = knn_method
         self.knn_rounds = knn_rounds
+        self.sym_mode = sym_mode
+        self.sym_slack = sym_slack
         self.mesh = make_mesh(n_devices)
         self.n_devices = self.mesh.devices.size
         d = self.n_devices
@@ -97,13 +101,29 @@ class SpmdPipeline:
         dist = jnp.where(valid[:, None], dist, jnp.inf)
         p_cond = pairwise_affinities(dist, cfg.perplexity, axis_name=AXIS)
 
-        # symmetrization: gather the [N, k] graph, do the (deterministic)
-        # sort/segment-sum replicated, keep my row slice
-        idx_g = lax.all_gather(idx, AXIS, tiled=True)
-        p_g = lax.all_gather(p_cond, AXIS, tiled=True)
-        jidx_f, jval_f = joint_distribution(idx_g, p_g, self.sym_width)
-        jidx = lax.dynamic_slice_in_dim(jidx_f, row_offset, self.n_local)
-        jval = lax.dynamic_slice_in_dim(jval_f, row_offset, self.n_local)
+        if self.sym_mode == "alltoall":
+            # scalable: transpose edges ROUTED to their owner shard over ICI
+            from tsne_flink_tpu.parallel.symmetrize import symmetrize_alltoall
+            jidx, jval, dropped = symmetrize_alltoall(
+                idx, p_cond, self.n_devices, self.sym_width,
+                slack=self.sym_slack, axis_name=AXIS)
+
+            def _warn_dropped(d):
+                if int(d) > 0:
+                    import sys
+                    print(f"WARNING: alltoall symmetrization dropped {int(d)} "
+                          "transpose edges (capacity cap); raise --symSlack",
+                          file=sys.stderr)
+
+            jax.debug.callback(_warn_dropped, dropped)
+        else:
+            # replicated: gather the [N, k] graph, do the (deterministic)
+            # sort/segment-sum everywhere, keep my row slice
+            idx_g = lax.all_gather(idx, AXIS, tiled=True)
+            p_g = lax.all_gather(p_cond, AXIS, tiled=True)
+            jidx_f, jval_f = joint_distribution(idx_g, p_g, self.sym_width)
+            jidx = lax.dynamic_slice_in_dim(jidx_f, row_offset, self.n_local)
+            jval = lax.dynamic_slice_in_dim(jval_f, row_offset, self.n_local)
 
         # init y from the GLOBAL key so the embedding is device-count-invariant
         ikey = jax.random.fold_in(key, 2)

@@ -63,6 +63,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "default 2*neighbors. Rows whose symmetrized degree "
                         "exceeds it drop their largest-id entries (with exact "
                         "renormalization) — raise it for hub-heavy kNN graphs")
+    p.add_argument("--symMode", default="replicated",
+                   choices=["replicated", "alltoall"],
+                   help="(--spmd only) symmetrization strategy: replicated "
+                        "sort of the gathered kNN graph (simple, to ~1M "
+                        "points) or all_to_all-routed transpose edges "
+                        "(footprint independent of mesh size)")
+    p.add_argument("--symSlack", type=int, default=4,
+                   help="(--symMode alltoall) per-destination capacity "
+                        "headroom factor")
     p.add_argument("--spmd", action="store_true",
                    help="run the WHOLE pipeline (kNN, affinities, optimize) "
                         "as one sharded program on the mesh — kNN over the "
@@ -217,7 +226,8 @@ def main(argv=None) -> int:
         pipe = SpmdPipeline(cfg, n, args.dimension, neighbors,
                             knn_method=args.knnMethod,
                             knn_rounds=args.knnIterations,
-                            sym_width=args.symWidth,
+                            sym_width=args.symWidth, sym_mode=args.symMode,
+                            sym_slack=args.symSlack,
                             n_devices=args.devices)
         if args.executionPlan:
             lowered = pipe.lower(x, key)
