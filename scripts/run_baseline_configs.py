@@ -1,0 +1,145 @@
+"""Run every BASELINE.json workload shape end-to-end through the CLI.
+
+The five configs (BASELINE.json "configs") exercise every major surface:
+bruteforce/project kNN, theta BH, cosine metric, 3-D embeddings, high early
+exaggeration, precomputed-kNN distance-matrix input, and the multi-host SPMD
+path.  ``--scale`` shrinks N for CPU smoke runs (default 0.02); on TPU run
+with ``--scale 1``.
+
+Usage: python scripts/run_baseline_configs.py [--scale F] [--backend cpu|tpu]
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def make_coo(path, n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.random((10, d)).astype(np.float32)
+    x = centers[rng.integers(0, 10, n)] + 0.1 * rng.standard_normal(
+        (n, d)).astype(np.float32)
+    with open(path, "w") as f:
+        for i in range(n):
+            row = x[i]
+            f.write("\n".join(f"{i},{j},{float(row[j])!r}"
+                              for j in range(d)) + "\n")
+    return x
+
+
+def make_knn_coo(path, n, d, k, seed=0):
+    """Precomputed-kNN distance matrix in COO (i, j, dist) — config 4."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    idx = np.argsort(d2, axis=1)[:, :k]
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write("\n".join(
+                f"{i},{int(j)},{float(d2[i, j])!r}" for j in idx[i]) + "\n")
+
+
+def cli(args, env=None):
+    cmd = [sys.executable, "-m", "tsne_flink_tpu.utils.cli"] + args
+    t0 = time.time()
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    dt = time.time() - t0
+    if r.returncode != 0:
+        print(r.stdout[-1500:], r.stderr[-1500:])
+        raise SystemExit(f"FAILED: {' '.join(args)}")
+    return dt, r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.02)
+    ap.add_argument("--backend", default=None,
+                    help="cpu forces the 8-device virtual mesh")
+    opts = ap.parse_args()
+    s = opts.scale
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join([os.getcwd(),
+                                         env.get("PYTHONPATH", "")])
+    if opts.backend == "cpu":
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+        env["TSNE_FORCE_CPU"] = "1"  # honored by the CLI (test/dev escape)
+
+    tmp = tempfile.mkdtemp(prefix="tsne_baseline_")
+
+    def p(name):
+        return os.path.join(tmp, name)
+
+    results = []
+
+    # config 1: MNIST-2.5k dense COO, bruteforce, sqeuclidean, 1000 iters
+    n1 = max(200, int(2500 * s * 10))
+    make_coo(p("c1.csv"), n1, 784 if s >= 1 else 32)
+    dt, out = cli(["--input", p("c1.csv"), "--output", p("c1_out.csv"),
+                   "--dimension", "784" if s >= 1 else "32",
+                   "--knnMethod", "bruteforce", "--iterations",
+                   "1000" if s >= 1 else "100", "--perplexity", "30"
+                   if s >= 1 else "10"], env)
+    results.append(("config1 bruteforce 2.5k-class", n1, dt, out))
+
+    # config 2: MNIST-60k, project kNN, theta=0.5 BH, perplexity 30
+    n2 = max(400, int(60000 * s))
+    make_coo(p("c2.csv"), n2, 784 if s >= 1 else 32, seed=1)
+    dt, out = cli(["--input", p("c2.csv"), "--output", p("c2_out.csv"),
+                   "--dimension", "784" if s >= 1 else "32",
+                   "--knnMethod", "project", "--theta", "0.5",
+                   "--repulsion", "bh",
+                   "--perplexity", "30" if s >= 1 else "8",
+                   "--iterations", "300" if s >= 1 else "60"], env)
+    results.append(("config2 project+BH 60k-class", n2, dt, out))
+
+    # config 3: Fashion-70k, cosine, nComponents=3, earlyExaggeration=12
+    n3 = max(400, int(70000 * s))
+    make_coo(p("c3.csv"), n3, 784 if s >= 1 else 32, seed=2)
+    dt, out = cli(["--input", p("c3.csv"), "--output", p("c3_out.csv"),
+                   "--dimension", "784" if s >= 1 else "32",
+                   "--knnMethod", "project", "--metric", "cosine",
+                   "--nComponents", "3", "--earlyExaggeration", "12",
+                   "--perplexity", "30" if s >= 1 else "8",
+                   "--iterations", "300" if s >= 1 else "60"], env)
+    y3 = np.loadtxt(p("c3_out.csv"), delimiter=",")
+    assert y3.shape[1] == 4, "id + 3 components"
+    results.append(("config3 cosine 3-D 70k-class", n3, dt, out))
+
+    # config 4: precomputed-kNN distance matrix input (GloVe-400k-class)
+    n4 = max(300, int(400000 * s * 0.2))
+    make_knn_coo(p("c4.csv"), n4, 16, 12, seed=3)
+    dt, out = cli(["--input", p("c4.csv"), "--output", p("c4_out.csv"),
+                   "--dimension", "100", "--knnMethod", "bruteforce",
+                   "--inputDistanceMatrix", "--neighbors", "12",
+                   "--perplexity", "4", "--iterations", "60"], env)
+    results.append(("config4 distance-matrix 400k-class", n4, dt, out))
+
+    # config 5: 1.3M multi-host analog — full SPMD pipeline (single process
+    # here; tests/test_multiprocess.py covers the true 2-process run)
+    n5 = max(500, int(1_300_000 * s * 0.01))
+    make_coo(p("c5.csv"), n5, 32, seed=4)
+    dt, out = cli(["--input", p("c5.csv"), "--output", p("c5_out.csv"),
+                   "--dimension", "32", "--knnMethod", "project",
+                   "--perplexity", "50" if s >= 1 else "8",
+                   "--iterations", "60", "--spmd", "--symMode", "alltoall"],
+                  env)
+    results.append(("config5 spmd 1.3M-class", n5, dt, out))
+
+    print(f"\nall {len(results)} BASELINE configs ran end-to-end "
+          f"(scale={s}):")
+    for name, n, dt, out in results:
+        print(f"  {name:36s} n={n:<7d} {dt:6.1f}s  | {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -108,14 +108,14 @@ class SpmdPipeline:
                 idx, p_cond, self.n_devices, self.sym_width,
                 slack=self.sym_slack, axis_name=AXIS)
 
-            def _warn_dropped(d):
-                if int(d) > 0:
+            def _warn_dropped(d, dev):
+                if int(d) > 0 and int(dev) == 0:  # once, not once per device
                     import sys
                     print(f"WARNING: alltoall symmetrization dropped {int(d)} "
                           "transpose edges (capacity cap); raise --symSlack",
                           file=sys.stderr)
 
-            jax.debug.callback(_warn_dropped, dropped)
+            jax.debug.callback(_warn_dropped, dropped, me)
         else:
             # replicated: gather the [N, k] graph, do the (deterministic)
             # sort/segment-sum everywhere, keep my row slice

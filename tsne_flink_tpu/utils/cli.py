@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -154,6 +155,12 @@ def main(argv=None) -> int:
 
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
+
+    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+        # dev/test escape hatch: the container's sitecustomize latches the
+        # accelerator platform before env vars are read, so pin via config
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
 
     multihost = (args.coordinator, args.numProcesses, args.processId)
     if any(v is not None for v in multihost):
