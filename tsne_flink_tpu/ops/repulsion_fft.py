@@ -37,8 +37,13 @@ from jax import lax
 
 #: node spacing must stay well under the kernel's unit scale as the embedding
 #: spreads out late in optimization (span ~100-200 units): 1024 nodes keeps
-#: h <= 0.2 there, and a 2048² real FFT is still sub-millisecond on TPU
-DEFAULT_GRID = {2: 1024, 3: 64}
+#: h <= 0.2 there, and a 2048² real FFT is still sub-millisecond on TPU.
+#: 3-D CANNOT reach that spacing (1024³ nodes is 4 GiB per channel): even at
+#: 128³ the measured max relative force error is 12% at span 50 and 69% at
+#: span 100 (vs 3e-4 at span 10; scripts in tests/test_fft.py) — so 3-D FFT
+#: is only fit for tight embeddings, and ``--repulsion auto`` routes
+#: 3-component runs to Barnes-Hut instead (utils/cli.py:pick_repulsion).
+DEFAULT_GRID = {2: 1024, 3: 128}
 
 
 def _lagrange_weights(t: jnp.ndarray, p: int) -> jnp.ndarray:

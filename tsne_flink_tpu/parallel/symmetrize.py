@@ -43,8 +43,13 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     conditional affinities (0 = absent).  Returns ``(jidx, jval, dropped)``
     with ``jidx/jval`` [n_local, sym_width] normalized so the GLOBAL ΣP = 1
     (valid entries floored at 1e-12, as the reference intended), and
-    ``dropped`` the psum'd count of transpose edges lost to the capacity cap
-    (0 in healthy runs).
+    ``dropped`` a psum'd int[2]: ``dropped[0]`` transpose edges lost to the
+    all_to_all capacity cap (raise ``slack``), ``dropped[1]`` merged (i, j)
+    runs lost to ``sym_width`` row overflow (raise the width).  Both are 0 in
+    healthy runs; a nonzero count means P was altered — a capacity-dropped
+    transpose edge even leaves its forward twin behind, making P asymmetric —
+    so callers must surface it (or fail, --symStrict) rather than stay silent
+    (ADVICE r1).
     """
     n_local, k = idx.shape
     e = n_local * k
@@ -114,7 +119,8 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     # phantom (row, 0) runs
     ii = jnp.where(vv_all > 0, ii, n_local)
 
-    jidx, jval = assemble_rows(ii, jj, vv_all, n_local, sym_width)
+    jidx, jval, width_dropped = assemble_rows(ii, jj, vv_all, n_local,
+                                              sym_width, return_dropped=True)
 
     total = lax.psum(jnp.sum(jval), axis_name)
     valid = jval > 0
@@ -123,4 +129,5 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     jidx = jnp.where(valid, jidx, 0)
     # local row ids -> global neighbor ids are already global in jj; jidx holds
     # global ids because jj was global throughout
-    return jidx, jval, lax.psum(dropped, axis_name)
+    return jidx, jval, lax.psum(
+        jnp.stack([dropped, width_dropped]).astype(jnp.int32), axis_name)
