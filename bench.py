@@ -111,6 +111,7 @@ def main():
     from tsne_flink_tpu.ops.affinities import affinity_pipeline
     from tsne_flink_tpu.ops.knn import knn_project
     from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    from tsne_flink_tpu.utils.cli import pick_knn_rounds
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 300
@@ -120,11 +121,13 @@ def main():
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=0.5,
                      repulsion=repulsion, row_chunk=4096)
     k = 90  # 3 * perplexity (Tsne.scala:55)
+    rounds = pick_knn_rounds(n)  # the same auto recall policy the CLI runs
 
     x = jnp.asarray(x_np)
     t0 = time.time()
     idx, dist = jax.jit(
-        lambda xx: knn_project(xx, k, rounds=3, key=jax.random.key(0)))(x)
+        lambda xx: knn_project(xx, k, rounds=rounds,
+                               key=jax.random.key(0)))(x)
     idx.block_until_ready()
     t_knn = time.time() - t0
 

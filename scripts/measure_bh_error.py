@@ -1,0 +1,70 @@
+"""BH repulsion at scale: wall-clock + error vs exact on a row subsample.
+
+VERDICT r1 next-step #10: exercise the frontier-overflow early-accept path
+(ops/repulsion_bh.py) under REAL occupancy (n >= 100k) on hardware, and log
+both the per-call time and the measured force error.  The exact ground truth
+is affordable because it only needs a row block: ``exact_repulsion(rows,
+y_full)`` evaluates the full N-body sum for the first SAMPLE rows.
+
+Usage: python scripts/measure_bh_error.py [N] [SAMPLE]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def clustered_embedding(n, m=2, clusters=10, span=80.0, seed=0):
+    """Late-optimization-shaped synthetic embedding: tight clusters over a
+    wide span — the occupancy profile that stresses the frontier."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, m)) * (span / 2.5)
+    return (centers[rng.integers(0, clusters, n)]
+            + rng.standard_normal((n, m)) * 1.5).astype(np.float32)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    sample = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+
+    import jax
+    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.ops.repulsion_bh import bh_repulsion, default_levels
+    from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    enable_compilation_cache()
+
+    y = jnp.asarray(clustered_embedding(n))
+    print(f"n={n} sample={sample} backend={jax.default_backend()} "
+          f"levels(auto)={default_levels(n, 2)}")
+
+    rep_e, _ = jax.jit(lambda a: exact_repulsion(a[:sample], a))(y)
+    rep_e.block_until_ready()
+    den = float(jnp.max(jnp.linalg.norm(rep_e, axis=1)))
+
+    for theta in (0.5, 0.25):
+        for frontier in (16, 32, 64):
+            fn = jax.jit(lambda a, th=theta, fr=frontier: bh_repulsion(
+                a, theta=th, frontier=fr))
+            rep_b, z_b = fn(y)
+            rep_b.block_until_ready()  # compile
+            t0 = time.time()
+            rep_b, z_b = fn(y)
+            rep_b.block_until_ready()
+            dt = time.time() - t0
+            err = float(jnp.max(jnp.linalg.norm(
+                rep_b[:sample] - rep_e, axis=1))) / den
+            print(f"  theta={theta} frontier={frontier:3d}: "
+                  f"{dt * 1000:8.1f} ms/call  max rel err (on {sample} rows) "
+                  f"{err:.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,10 @@ def main():
     k = int(args[2]) if len(args) > 2 else 90
 
     import jax
+    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+        # sitecustomize latches JAX_PLATFORMS to the accelerator before any
+        # script code runs; config update is the only reliable CPU pin
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from tsne_flink_tpu.ops.knn import knn_partition, knn_project
@@ -53,8 +57,10 @@ def main():
     print(f"n={n} d={d} k={k} exact(partition): {t_exact:.2f}s "
           f"[{jax.default_backend()}]")
 
-    combos = ([(r, p, b) for r in (1, 2, 3, 4, 6) for p in (2, 3, 4)
-               for b in (512,)] if sweep else [(3, 3, 512)])
+    # proj_dims is 2 or 3 (zorder.BITS_FOR_DIMS); block trades tile size for
+    # band coverage (band = block + 2k)
+    combos = ([(r, p, b) for r in (1, 2, 3, 4, 6, 8) for p in (2, 3)
+               for b in (512, 1024)] if sweep else [(3, 3, 512)])
     for rounds, pdim, block in combos:
         t0 = time.time()
         _, dist_a = jax.jit(lambda a: knn_project(

@@ -1,0 +1,34 @@
+#!/bin/bash
+# The on-chip work queue (docs/TPU_STATUS.md), run in priority order the
+# moment the axon tunnel serves a backend.  Each step logs to .tpu_queue/
+# and failures don't block later steps.  Safe to re-run; bench.py's own
+# fresh-process retry wrapper handles mid-queue tunnel flakes.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p .tpu_queue
+Q=.tpu_queue
+export TSNE_BENCH_INIT_TIMEOUT=240 TSNE_BENCH_INIT_RETRIES=2
+
+step() {
+  local name=$1; shift
+  echo "=== $name: $* [$(date +%H:%M:%S)]" | tee -a $Q/queue.log
+  timeout "$STEP_TIMEOUT" "$@" > "$Q/$name.log" 2>&1
+  echo "=== $name rc=$? [$(date +%H:%M:%S)]" | tee -a $Q/queue.log
+}
+
+# 1. headline bench (fft default) — the round's deliverable
+STEP_TIMEOUT=1800 step bench_60k_fft python bench.py 60000 300 fft
+# 2. pallas-exact on hardware (Mosaic lowering proof) at bench scale
+STEP_TIMEOUT=1800 step bench_60k_exact python bench.py 60000 300 exact
+# 3. BH backend at bench scale
+STEP_TIMEOUT=1800 step bench_60k_bh python bench.py 60000 300 bh
+# 4. the 1M north star
+STEP_TIMEOUT=2400 step bench_1m_fft python bench.py 1000000 300 fft
+# 5. recall at bench shape
+STEP_TIMEOUT=1800 step recall_60k python scripts/measure_recall.py 60000 784 90 --sweep
+# 6. all five BASELINE configs at full size
+STEP_TIMEOUT=3600 step baseline_full python scripts/run_baseline_configs.py --scale 1
+# 7. BH at 100k with error vs exact subsample
+STEP_TIMEOUT=1800 step bh_100k python scripts/measure_bh_error.py 100000
+# 8. stage profile at 60k
+STEP_TIMEOUT=1200 step profile_60k python scripts/profile_stages.py 60000 50 fft
+echo "=== queue complete [$(date +%H:%M:%S)]" | tee -a $Q/queue.log

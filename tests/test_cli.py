@@ -85,7 +85,9 @@ def test_parser_defaults_match_reference():
     # from "explicitly requested" — an explicit theta steers --repulsion auto
     assert a.theta is None
     assert a.loss == "loss.txt"
-    assert a.knnIterations == 3
+    # knnIterations parses to None -> pick_knn_rounds(n) (reference default 3
+    # at small N; auto-grows with N for recall — Tsne.scala:61)
+    assert a.knnIterations is None
 
 
 def test_lossfile_alias():

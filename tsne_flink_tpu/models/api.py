@@ -30,7 +30,7 @@ class TSNE:
                  initial_momentum: float = 0.5, final_momentum: float = 0.8,
                  theta: float | None = None, repulsion: str = "auto",
                  knn_method: str = "bruteforce", neighbors: int | None = None,
-                 knn_blocks: int = 8, knn_iterations: int = 3,
+                 knn_blocks: int = 8, knn_iterations: int | None = None,
                  random_state: int = 0):
         self.n_components = n_components
         self.perplexity = perplexity
@@ -71,11 +71,15 @@ class TSNE:
     def fit(self, x, y=None) -> "TSNE":
         import jax.numpy as jnp
 
+        from tsne_flink_tpu.utils.cli import pick_knn_rounds
+
         x = jnp.asarray(x)
         cfg = self._config(x.shape[0])
+        rounds = (self.knn_iterations if self.knn_iterations is not None
+                  else pick_knn_rounds(x.shape[0]))  # same policy as the CLI
         y, losses = tsne_embed(
             x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
-            knn_blocks=self.knn_blocks, knn_iterations=self.knn_iterations,
+            knn_blocks=self.knn_blocks, knn_iterations=rounds,
             seed=self.random_state)
         self.embedding_ = np.asarray(y)
         self.kl_trace_ = np.asarray(losses)

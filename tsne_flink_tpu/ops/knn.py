@@ -143,7 +143,7 @@ def merge_rounds(dists: list, idxs: list, k: int):
 
 def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                 rounds: int = 3, key: jax.Array | None = None,
-                *, proj_dims: int = 3, block: int = 512):
+                *, proj_dims: int = 3, block: int = 1024):
     """Approximate kNN via random-shift Z-order rounds + exact banded re-rank.
 
     Reference ``projectKnn`` (``TsneHelpers.scala:93-160``): 1 unshifted round +
@@ -174,6 +174,13 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
     Per-round top-k results are merged across rounds by per-row id-sort dedup
     and a final smallest-k — the regular-array form of the reference's
     union/groupBy dedup/re-rank (``TsneHelpers.scala:113-133``).
+
+    Recall@k is governed by ``rounds`` and the band width (``block + 2k``).
+    Measured at 8k x 784 blobs, k=90 (scripts/measure_recall.py sweep):
+    rounds=3/block=512 -> 0.69, rounds=3/block=1024 -> 0.86,
+    rounds=6/block=1024 -> 0.98, rounds=8/block=1024 -> 0.99.  Hence
+    block=1024 default; the CLI auto-scales rounds with N when
+    ``--knnIterations`` is not given.
     """
     n, dim = x.shape
     k = _clamp_k(k, n)

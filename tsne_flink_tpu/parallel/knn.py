@@ -124,7 +124,7 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
                         n_global: int, metric: str = "sqeuclidean",
                         rounds: int = 3, key: jax.Array | None = None, *,
                         axis_name: str = "points", proj_dims: int = 3,
-                        block: int = 512):
+                        block: int = 1024):
     """Sharded approximate kNN: random-shift Morton rounds + banded re-rank,
     with the band work split across the mesh by sorted block range.
 

@@ -138,11 +138,19 @@ class ShardedOptimizer:
 
     def __call__(self, state: TsneState, jidx, jval, *, start_iter: int = 0,
                  loss_carry=None, checkpoint_every: int = 0,
-                 checkpoint_cb=None):
+                 checkpoint_cb=None, pre_padded_valid=None, unpad: bool = True):
         """Run iterations [start_iter, cfg.iterations); if checkpointing,
         ``checkpoint_cb(state, next_iter, losses)`` fires every
-        ``checkpoint_every`` iterations with the UNPADDED state."""
-        if self.n_devices == 1:
+        ``checkpoint_every`` iterations with the UNPADDED state.
+
+        Multi-controller callers pass arrays that are ALREADY padded global
+        jax.Arrays (host-side pad/slice of non-addressable arrays is
+        impossible): ``pre_padded_valid`` supplies the validity mask and skips
+        the padding here, and ``unpad=False`` returns the padded global state
+        (the caller gathers/slices with ``process_allgather``)."""
+        if pre_padded_valid is not None:
+            valid = pre_padded_valid
+        elif self.n_devices == 1:
             valid = None
         else:
             state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
@@ -168,8 +176,9 @@ class ShardedOptimizer:
                                               it, losses)
             it += step
             if checkpoint_cb is not None and it < total:
-                checkpoint_cb(self._unpad(state), it, losses)
-        return self._unpad(state), losses
+                checkpoint_cb(self._unpad(state) if unpad else state,
+                              it, losses)
+        return (self._unpad(state) if unpad else state), losses
 
 
 def shard_pipeline(cfg: TsneConfig, n: int,
