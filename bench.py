@@ -118,6 +118,11 @@ def main():
     repulsion = sys.argv[3] if len(sys.argv) > 3 else "fft"
     x_np = make_data(n)
 
+    if jax.default_backend() == "tpu":
+        # warm the one-time Mosaic lowering probe outside any trace
+        from tsne_flink_tpu.ops.repulsion_pallas import mosaic_supported
+        mosaic_supported()
+
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=0.5,
                      repulsion=repulsion, row_chunk=4096)
     k = 90  # 3 * perplexity (Tsne.scala:55)

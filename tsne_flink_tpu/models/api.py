@@ -71,15 +71,11 @@ class TSNE:
     def fit(self, x, y=None) -> "TSNE":
         import jax.numpy as jnp
 
-        from tsne_flink_tpu.utils.cli import pick_knn_rounds
-
         x = jnp.asarray(x)
         cfg = self._config(x.shape[0])
-        rounds = (self.knn_iterations if self.knn_iterations is not None
-                  else pick_knn_rounds(x.shape[0]))  # same policy as the CLI
         y, losses = tsne_embed(
             x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
-            knn_blocks=self.knn_blocks, knn_iterations=rounds,
+            knn_blocks=self.knn_blocks, knn_iterations=self.knn_iterations,
             seed=self.random_state)
         self.embedding_ = np.asarray(y)
         self.kl_trace_ = np.asarray(losses)

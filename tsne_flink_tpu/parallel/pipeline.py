@@ -50,7 +50,7 @@ class SpmdPipeline:
     """
 
     def __init__(self, cfg: TsneConfig, n: int, dim: int, k: int,
-                 knn_method: str = "bruteforce", knn_rounds: int = 3,
+                 knn_method: str = "bruteforce", knn_rounds: int | None = None,
                  sym_width: int | None = None, sym_mode: str = "replicated",
                  sym_slack: int = 4, sym_strict: bool = False,
                  n_devices: int | None = None):
@@ -61,7 +61,9 @@ class SpmdPipeline:
         self.n = n
         self.k = int(min(k, n - 1))
         self.knn_method = knn_method
-        self.knn_rounds = knn_rounds
+        from tsne_flink_tpu.ops.knn import pick_knn_rounds
+        self.knn_rounds = (knn_rounds if knn_rounds is not None
+                           else pick_knn_rounds(n))
         self.sym_mode = sym_mode
         self.sym_slack = sym_slack
         self.mesh = make_mesh(n_devices)

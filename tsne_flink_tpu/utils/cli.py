@@ -108,15 +108,11 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# policy lives next to the mechanism (ops/knn.py); re-exported here because
+# the CLI is where users meet it and tests/scripts import it from both
 def pick_knn_rounds(n: int) -> int:
-    """Auto project-kNN rounds: recall decays with N at fixed band width, so
-    rounds grow ~2·log2(N/1000), clamped to [3, 12] (3 = the reference's
-    knnIterations default, Tsne.scala:61).  Measured basis: recall@90 on 8k
-    points was 0.86 at 3 rounds and 0.98 at 6 (scripts/measure_recall.py)."""
-    import math as _math
-    if n <= 1000:
-        return 3
-    return max(3, min(12, _math.ceil(2 * _math.log2(n / 1000))))
+    from tsne_flink_tpu.ops.knn import pick_knn_rounds as _p
+    return _p(n)
 
 
 def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2,
@@ -234,6 +230,11 @@ def main(argv=None) -> int:
 
     t0 = time.time()
     dtype = jnp.dtype(args.dtype)
+    if jax.default_backend() == "tpu" and args.dtype != "float64":
+        # warm the one-time Mosaic lowering probe OUTSIDE any trace, so the
+        # in-trace exact_impl=auto decision is a pure cache read
+        from tsne_flink_tpu.ops.repulsion_pallas import mosaic_supported
+        mosaic_supported()
     neighbors = (args.neighbors if args.neighbors is not None
                  else 3 * int(args.perplexity))
 
@@ -248,8 +249,6 @@ def main(argv=None) -> int:
     else:
         ids, x_np = tio.read_input(args.input, args.dimension)
         n = len(ids)
-        knn_rounds = (args.knnIterations if args.knnIterations is not None
-                      else pick_knn_rounds(n))
         x = jnp.asarray(x_np, dtype)
         key = jax.random.key(args.randomState)
         if not args.spmd:
@@ -257,7 +256,7 @@ def main(argv=None) -> int:
                 lambda xx: knn_dispatch(
                     xx, neighbors, args.knnMethod, args.metric,
                     blocks=args.knnBlocks or jax.device_count(),
-                    rounds=knn_rounds, key=key))(x)
+                    rounds=args.knnIterations, key=key))(x)
 
     cfg = TsneConfig(
         n_components=args.nComponents,
@@ -281,7 +280,7 @@ def main(argv=None) -> int:
         from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
         pipe = SpmdPipeline(cfg, n, args.dimension, neighbors,
                             knn_method=args.knnMethod,
-                            knn_rounds=knn_rounds,
+                            knn_rounds=args.knnIterations,
                             sym_width=args.symWidth, sym_mode=args.symMode,
                             sym_slack=args.symSlack,
                             sym_strict=args.symStrict,
