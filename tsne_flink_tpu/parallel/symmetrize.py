@@ -49,7 +49,9 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     healthy runs; a nonzero count means P was altered — a capacity-dropped
     transpose edge even leaves its forward twin behind, making P asymmetric —
     so callers must surface it (or fail, --symStrict) rather than stay silent
-    (ADVICE r1).
+    (ADVICE r1).  The fourth output ``needed`` is the pmax'd TRUE max row
+    degree (multiple of 8) — the width that loses nothing, for SpmdPipeline
+    auto-escalation.
     """
     n_local, k = idx.shape
     e = n_local * k
@@ -119,8 +121,9 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     # phantom (row, 0) runs
     ii = jnp.where(vv_all > 0, ii, n_local)
 
-    jidx, jval, width_dropped = assemble_rows(ii, jj, vv_all, n_local,
-                                              sym_width, return_dropped=True)
+    jidx, jval, width_dropped, needed = assemble_rows(
+        ii, jj, vv_all, n_local, sym_width,
+        return_dropped=True, return_needed=True)
 
     total = lax.psum(jnp.sum(jval), axis_name)
     valid = jval > 0
@@ -130,4 +133,5 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     # local row ids -> global neighbor ids are already global in jj; jidx holds
     # global ids because jj was global throughout
     return jidx, jval, lax.psum(
-        jnp.stack([dropped, width_dropped]).astype(jnp.int32), axis_name)
+        jnp.stack([dropped, width_dropped]).astype(jnp.int32), axis_name), \
+        lax.pmax(needed, axis_name)
