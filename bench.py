@@ -113,9 +113,9 @@ def main():
 
     from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
     from tsne_flink_tpu.ops.affinities import affinity_pipeline
-    from tsne_flink_tpu.ops.knn import knn_project
+    from tsne_flink_tpu.ops.knn import (knn as knn_dispatch,
+                                        pick_knn_refine, pick_knn_rounds)
     from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
-    from tsne_flink_tpu.utils.cli import pick_knn_rounds
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 300
@@ -130,13 +130,15 @@ def main():
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=0.5,
                      repulsion=repulsion, row_chunk=4096)
     k = 90  # 3 * perplexity (Tsne.scala:55)
-    rounds = pick_knn_rounds(n)  # the same auto recall policy the CLI runs
+    # the same auto recall policy the CLI runs: Z-order seed + NN-descent
+    rounds = pick_knn_rounds(n)
+    refine = pick_knn_refine(n)
 
     x = jnp.asarray(x_np)
     t0 = time.time()
     idx, dist = jax.jit(
-        lambda xx: knn_project(xx, k, rounds=rounds,
-                               key=jax.random.key(0)))(x)
+        lambda xx: knn_dispatch(xx, k, "project", rounds=rounds,
+                                refine=refine, key=jax.random.key(0)))(x)
     idx.block_until_ready()
     t_knn = time.time() - t0
 
@@ -163,13 +165,15 @@ def main():
         affinity_flops, knn_flops, optimize_flops, peak_flops)
     backend = jax.default_backend()
     s = int(jidx.shape[1])  # true symmetrized row width the optimizer ran
-    f_knn = knn_flops(n, 784, k, "project", rounds=rounds)
+    f_knn = knn_flops(n, int(x_np.shape[1]), k, "project", rounds=rounds,
+                      refine_rounds=refine)
     f_aff = affinity_flops(n, k)
     f_opt = optimize_flops(n, s, 2, iters, repulsion,
                            mpad=8 if backend == "tpu" else 3)
     flops = f_knn + f_aff + f_opt
     kind = jax.devices()[0].device_kind if backend == "tpu" else ""
     peak, basis = peak_flops(backend, kind, jax.device_count())
+    mfu = round(flops / (total * peak), 5) if peak else None
     print(json.dumps({
         "metric": "mnist60k_embed_seconds",
         "value": round(total, 3),
@@ -181,12 +185,12 @@ def main():
                    "optimize": round(t_opt, 3)},
         "stage_flops": {"knn": f_knn, "affinities": f_aff, "optimize": f_opt},
         "flops": flops,
-        "mfu": round(flops / (total * peak), 5),
+        "mfu": mfu,
         "peak_flops": peak,
         "peak_flops_basis": basis,
         "final_kl": round(float(losses[-1]), 4),
         "n": n, "iterations": iters, "repulsion": repulsion,
-        "knn_rounds": rounds, "sym_width": s,
+        "knn_rounds": rounds, "knn_refine": refine, "sym_width": s,
     }))
 
 

@@ -45,7 +45,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    from tsne_flink_tpu.ops.knn import knn_partition, knn_project
+    from tsne_flink_tpu.ops.knn import knn_partition
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
 
@@ -59,18 +59,24 @@ def main():
 
     # proj_dims is 2 or 3 (zorder.BITS_FOR_DIMS); block trades tile size for
     # band coverage (band = block + 2k)
-    combos = ([(r, p, b) for r in (1, 2, 3, 4, 6, 8) for p in (2, 3)
-               for b in (512, 1024)] if sweep else [(3, 3, 512)])
-    for rounds, pdim, block in combos:
+    from tsne_flink_tpu.ops.knn import (knn as knn_dispatch,
+                                        pick_knn_refine, pick_knn_rounds)
+    auto = (pick_knn_rounds(n), pick_knn_refine(n))
+    # (zorder_seed_rounds, hybrid_cycles) plans; cycles=0 rows show why the
+    # hybrid policy exists (banded Z-order rounds saturate at large N)
+    plans = ([(3, 0), (6, 0), (12, 0), (3, 1), (3, 2), (3, 3), (3, 4),
+              (3, 5), auto] if sweep else [auto])
+    plans = list(dict.fromkeys(plans))
+    for rounds, cycles in plans:
         t0 = time.time()
-        _, dist_a = jax.jit(lambda a: knn_project(
-            a, k, rounds=rounds, key=jax.random.key(0), proj_dims=pdim,
-            block=block))(x)
+        idx_a, dist_a = jax.jit(lambda a, r=rounds, c=cycles: knn_dispatch(
+            a, k, "project", rounds=r, refine=c, key=jax.random.key(0)))(x)
         dist_a.block_until_ready()
         dt = time.time() - t0
         r = recall_at_k(np.asarray(dist_a), np.asarray(dist_x))
-        print(f"  project rounds={rounds} proj_dims={pdim} block={block}: "
-              f"recall@{k}={r:.4f}  {dt:.2f}s")
+        tag = " (auto)" if (rounds, cycles) == auto else ""
+        print(f"  project seed={rounds} cycles={cycles}: "
+              f"recall@{k}={r:.4f}  {dt:.2f}s{tag}")
 
 
 if __name__ == "__main__":

@@ -71,6 +71,9 @@ def test_peak_flops_known_kinds():
     p_cpu, basis_c = peak_flops("cpu", cpu_cores=16)
     assert p_cpu == pytest.approx(16 * 32e9)
     assert "nominal" in basis_c
+    p_gpu, basis_g = peak_flops("gpu")
+    assert p_gpu is None  # no made-up peaks: caller reports MFU unknown
+    assert "unrecognized" in basis_g
 
 
 def test_unknown_backends_raise():

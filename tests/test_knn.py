@@ -96,31 +96,98 @@ def test_project_low_dim_no_projection_path():
 
 
 def test_project_knn_recall_at_scale():
-    """VERDICT r1 next-step #5: pin recall@k >= 0.9 at n >= 5k on MNIST-like
-    shape with the tuned settings (block=1024 default + auto rounds).
-    Sweep basis in scripts/measure_recall.py."""
+    """VERDICT r1 next-step #5 / r2 next-step #4: pin recall@k >= 0.9 at
+    n >= 5k on MNIST-like shape under the FULL auto plan (Z-order seed +
+    NN-descent refinement).  Sweep basis in scripts/measure_recall.py."""
     import sys, os
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
                                     "scripts"))
     from bench import make_data
     from measure_recall import recall_at_k
-    from tsne_flink_tpu.utils.cli import pick_knn_rounds
+    from tsne_flink_tpu.ops.knn import knn as knn_dispatch
 
     n, k = 5000, 90
     x = jnp.asarray(make_data(n, 784))
-    rounds = pick_knn_rounds(n)
-    assert rounds >= 5  # the auto heuristic must not undershoot here
     _, dist_exact = knn_bruteforce(x, k)
-    _, dist_approx = knn_project(x, k, rounds=rounds, key=jax.random.key(0))
+    _, dist_approx = knn_dispatch(x, k, "project", key=jax.random.key(0))
     recall = recall_at_k(np.asarray(dist_approx), np.asarray(dist_exact))
     assert recall >= 0.9, recall
 
 
-def test_pick_knn_rounds_heuristic():
+def test_pick_knn_plan_heuristic():
+    from tsne_flink_tpu.ops.knn import pick_knn_refine
     from tsne_flink_tpu.utils.cli import pick_knn_rounds
 
+    # small N: Z-order band covers most of the data, no refinement needed
     assert pick_knn_rounds(100) == 3     # tiny: the reference default
-    assert pick_knn_rounds(8000) == 6    # measured 0.98 recall at 8k
-    assert pick_knn_rounds(60000) == 12
-    assert pick_knn_rounds(10**7) == 12  # capped
+    assert pick_knn_refine(100) == 0
+    assert pick_knn_refine(4000) == 0
+    # large N: a fixed 3-round seed + N-scaled hybrid cycles (measured
+    # basis: 60k x 784 sweep in scripts/measure_recall.py — Z-order alone
+    # saturates at 0.76 recall@90 even at 12 rounds)
+    assert pick_knn_rounds(8000) == 3
+    assert pick_knn_refine(8000) == 2
+    assert pick_knn_rounds(60000) == 3
+    assert pick_knn_refine(60000) == 4
+    assert pick_knn_refine(10**7) == 5   # capped
+
+
+def test_reverse_sample():
+    from tsne_flink_tpu.ops.knn import _reverse_sample
+
+    # 0 -> {1, 2}; 1 -> {0, 2}; 2 -> {3, 0}; 3 -> {2, 1}
+    idx = jnp.asarray([[1, 2], [0, 2], [3, 0], [2, 1]], jnp.int32)
+    rev = np.asarray(_reverse_sample(idx, 3))
+    # in-neighbors: 0 <- {1, 2}; 1 <- {0, 3}; 2 <- {0, 1, 3}; 3 <- {2}
+    assert sorted(v for v in rev[0] if v >= 0) == [1, 2]
+    assert sorted(v for v in rev[1] if v >= 0) == [0, 3]
+    assert sorted(v for v in rev[2] if v >= 0) == [0, 1, 3]
+    assert sorted(v for v in rev[3] if v >= 0) == [2]
+
+
+def test_refine_recovers_poor_seed():
+    # a deliberately weak seed (1 Z-order round, recall well under 1) must be
+    # driven to (near-)exact by NN-descent refinement; distances stay exact
+    # for whatever neighbors are reported, rows stay ascending and self-free
+    from tsne_flink_tpu.ops.knn import knn_refine
+
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from measure_recall import recall_at_k
+
+    n, d, k = 800, 24, 10
+    x = jnp.asarray(blobs(n, d, seed=7))
+    _, dist_exact = knn_bruteforce(x, k)
+    # block=64 -> band 84 of 800: a genuinely weak seed (default block would
+    # cover the whole set at this n and make refinement a no-op)
+    idx0, dist0 = knn_project(x, k, rounds=1, key=jax.random.key(0),
+                              block=64)
+    r0 = recall_at_k(np.asarray(dist0), np.asarray(dist_exact))
+    assert r0 < 0.9  # seed must actually be poor for this test to mean much
+    idx1, dist1 = knn_refine(x, idx0, dist0, rounds=3)
+    r1 = recall_at_k(np.asarray(dist1), np.asarray(dist_exact))
+    # isotropic Gaussian clusters are NN-descent's worst case (distance
+    # concentration), so the bar here is a large measured improvement, not
+    # near-exactness; the ≥0.9 end-to-end bar lives in
+    # test_project_knn_recall_at_scale under the FULL auto plan
+    assert r1 > r0 + 0.15, (r0, r1)
+    d1 = np.asarray(dist1)
+    i1 = np.asarray(idx1)
+    assert (np.diff(d1, axis=1) >= 0).all()          # ascending rows
+    assert (i1 != np.arange(n)[:, None]).all()       # self never reported
+    # reported distances are the true metric values
+    dm = np.asarray(pairwise("sqeuclidean", x, x))
+    np.testing.assert_allclose(d1, dm[np.arange(n)[:, None], i1], atol=1e-9)
+
+
+def test_refine_row_chunk_invariant():
+    from tsne_flink_tpu.ops.knn import knn_refine
+
+    x = jnp.asarray(blobs(130, 6, seed=3))
+    idx0, dist0 = knn_project(x, 7, rounds=1, key=jax.random.key(1))
+    i1, d1 = knn_refine(x, idx0, dist0, rounds=2, row_chunk=32)
+    i2, d2 = knn_refine(x, idx0, dist0, rounds=2, row_chunk=128)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=0)

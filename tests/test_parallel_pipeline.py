@@ -253,6 +253,28 @@ def test_spmd_pipeline_sym_strict_raises_on_overflow():
         pipe(jnp.asarray(x), jax.random.key(11))
 
 
+def test_spmd_pipeline_precomputed_knn_matches_inline():
+    # knn_method="precomputed": feeding the SAME neighbor graph the ring kNN
+    # would compute must give the bit-identical embedding (the kNN stage is
+    # the only thing skipped; init seeds from the same global key)
+    n, d, k = 44, 7, 9
+    x = blobs(n, d, seed=4)
+    cfg = TsneConfig(iterations=12, repulsion="exact", row_chunk=8,
+                     perplexity=4.0)
+    key = jax.random.key(11)
+    y_inline, loss_inline = SpmdPipeline(
+        cfg, n, d, k, knn_method="bruteforce", n_devices=8)(jnp.asarray(x),
+                                                            key)
+    idx, dist = knn_bruteforce(jnp.asarray(x), k)
+    y_pre, loss_pre = SpmdPipeline(
+        cfg, n, d, k, knn_method="precomputed", n_devices=8)(
+        (idx, dist), key)
+    np.testing.assert_allclose(np.asarray(y_pre), np.asarray(y_inline),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(loss_pre),
+                               np.asarray(loss_inline), atol=1e-12)
+
+
 def test_spmd_pipeline_auto_width_escalates_on_hub_rows():
     # hub-heavy graph: point 0 is (near-)everyone's nearest neighbor, so its
     # symmetrized degree ~= n-1, far beyond the default ~2k width guess.  An

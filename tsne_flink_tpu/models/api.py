@@ -31,7 +31,7 @@ class TSNE:
                  theta: float | None = None, repulsion: str = "auto",
                  knn_method: str = "bruteforce", neighbors: int | None = None,
                  knn_blocks: int = 8, knn_iterations: int | None = None,
-                 random_state: int = 0):
+                 knn_refine: int | None = None, random_state: int = 0):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -49,6 +49,7 @@ class TSNE:
         self.neighbors = neighbors
         self.knn_blocks = knn_blocks
         self.knn_iterations = knn_iterations
+        self.knn_refine = knn_refine
         self.random_state = random_state
         self.embedding_ = None
         self.kl_divergence_ = None
@@ -76,7 +77,7 @@ class TSNE:
         y, losses = tsne_embed(
             x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
             knn_blocks=self.knn_blocks, knn_iterations=self.knn_iterations,
-            seed=self.random_state)
+            knn_refine=self.knn_refine, seed=self.random_state)
         self.embedding_ = np.asarray(y)
         self.kl_trace_ = np.asarray(losses)
         self.kl_divergence_ = (float(self.kl_trace_[-1])

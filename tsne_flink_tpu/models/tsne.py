@@ -276,7 +276,8 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
 
 def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
                neighbors: int | None = None, knn_method: str = "bruteforce",
-               knn_iterations: int | None = None, knn_blocks: int = 8,
+               knn_iterations: int | None = None, knn_refine: int | None = None,
+               knn_blocks: int = 8,
                seed: int = 0, sym_width: int | None = None):
     """Single-device end-to-end pipeline (the ``computeEmbedding`` analog,
     Tsne.scala:105-136): kNN -> β-calibrated affinities -> symmetrized P ->
@@ -288,7 +289,7 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
     kkey, ikey = jax.random.split(key)
     idx, dist = jax.jit(lambda xx: knn_dispatch(
         xx, k, knn_method, cfg.metric, blocks=knn_blocks,
-        rounds=knn_iterations, key=kkey))(x)
+        rounds=knn_iterations, refine=knn_refine, key=kkey))(x)
     jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width)
     state = init_working_set(ikey, n, cfg.n_components, x.dtype)
     run = jax.jit(partial(optimize, cfg=cfg))

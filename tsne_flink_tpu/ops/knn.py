@@ -48,15 +48,25 @@ def _clamp_k(k: int, n: int) -> int:
 
 
 def pick_knn_rounds(n: int) -> int:
-    """Auto project-kNN rounds: recall decays with N at fixed band width, so
-    rounds grow ~2·log2(N/1000), clamped to [3, 12] (3 = the reference's
-    knnIterations default, Tsne.scala:61).  Measured basis: recall@90 on 8k
-    points was 0.86 at 3 rounds and 0.98 at 6 (scripts/measure_recall.py).
-    This is THE auto policy — every entry point (CLI, estimator API, bench,
-    SpmdPipeline) resolves ``rounds=None`` through it."""
-    if n <= 1000:
-        return 3
-    return max(3, min(12, math.ceil(2 * math.log2(n / 1000))))
+    """Auto project-kNN Z-order SEED rounds.  Since refinement landed
+    (round 3), Z-order rounds only seed the graph — the hybrid refine cycles
+    (:func:`knn_project_refined`) do the recall work far cheaper than extra
+    band sweeps (measured at 60k x 784, k=90: 12 Z-order rounds alone reach
+    0.76 recall@90 — scripts/measure_recall.py).  3 is the reference's
+    knnIterations default (Tsne.scala:61).  This is THE auto policy — every
+    entry point (CLI, estimator API, bench, SpmdPipeline) resolves
+    ``rounds=None`` through it, paired with :func:`pick_knn_refine`."""
+    return 3  # seed only at any N; hybrid cycles carry recall from here
+
+
+def pick_knn_refine(n: int) -> int:
+    """Auto hybrid refine cycles (each = 2 fresh Z-order rounds + 1
+    NN-descent round) after the seed: none needed while the band covers a
+    large fraction of N; grows gently with N (measured operating points:
+    scripts/measure_recall.py, README table — 20k x 784: 0.98@2, 0.99@3)."""
+    if n <= 4000:
+        return 0
+    return max(2, min(5, math.ceil(math.log2(n / 4000))))
 
 
 def knn_bruteforce(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
@@ -131,31 +141,172 @@ def knn_partition(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
             dist.reshape(-1, k)[:n])
 
 
+def _dedup_smallest(cat_i: jnp.ndarray, cat_d: jnp.ndarray, k: int):
+    """Per-row: drop duplicate neighbor ids (keeping each id's SMALLEST
+    distance) and return the k nearest survivors.  Two-pass stable sort —
+    by distance, then by id — so within an id group the best copy comes
+    first; a plain id-sort could let an inf placeholder shadow a finite
+    duplicate of the same id (possible because unfilled project-kNN slots
+    carry clipped-but-real ids next to dist=inf)."""
+    n = cat_i.shape[0]
+    o1 = jnp.argsort(cat_d, axis=1)
+    ci = jnp.take_along_axis(cat_i, o1, axis=1)
+    cd = jnp.take_along_axis(cat_d, o1, axis=1)
+    o2 = jnp.argsort(ci, axis=1, stable=True)
+    ci = jnp.take_along_axis(ci, o2, axis=1)
+    cd = jnp.take_along_axis(cd, o2, axis=1)
+    dup = jnp.concatenate([jnp.zeros((n, 1), bool),
+                           ci[:, 1:] == ci[:, :-1]], axis=1)
+    cd = jnp.where(dup, jnp.inf, cd)
+    dd, sel = _topk_smallest(cd, k)
+    return jnp.take_along_axis(ci, sel, axis=1), dd
+
+
 def merge_rounds(dists: list, idxs: list, k: int):
-    """Merge per-round (dist, idx) candidate sets: per-row sort by neighbor
-    id, mask adjacent duplicates, keep smallest-k — the regular-array form of
-    the reference's union / groupBy-dedup / re-rank
-    (``TsneHelpers.scala:113-133``).  Shared by the single-device and sharded
-    project kNN."""
+    """Merge per-round (dist, idx) candidate sets: per-row dedup by neighbor
+    id, keep smallest-k — the regular-array form of the reference's union /
+    groupBy-dedup / re-rank (``TsneHelpers.scala:113-133``).  Shared by the
+    single-device and sharded project kNN."""
     if len(dists) == 1:
         return idxs[0], dists[0]
-    n = dists[0].shape[0]
-    cat_d = jnp.concatenate(dists, axis=1)
-    cat_i = jnp.concatenate(idxs, axis=1)
-    order = jnp.argsort(cat_i, axis=1)
-    cat_i = jnp.take_along_axis(cat_i, order, axis=1)
-    cat_d = jnp.take_along_axis(cat_d, order, axis=1)
-    dup = jnp.concatenate([jnp.zeros((n, 1), bool),
-                           (cat_i[:, 1:] == cat_i[:, :-1])
-                           & jnp.isfinite(cat_d[:, 1:])], axis=1)
-    cat_d = jnp.where(dup, jnp.inf, cat_d)
-    dd, sel = _topk_smallest(cat_d, k)
-    return jnp.take_along_axis(cat_i, sel, axis=1), dd
+    return _dedup_smallest(jnp.concatenate(idxs, axis=1),
+                           jnp.concatenate(dists, axis=1), k)
+
+
+def _reverse_sample(idx: jnp.ndarray, r: int,
+                    key: jax.Array | None = None) -> jnp.ndarray:
+    """``r`` IN-neighbors of every point in the directed graph ``idx``
+    [N, k]: one ``lax.sort`` of the (dst, score, src) edge list + run-rank
+    scatter — the same regular-array groupBy used by the symmetrizer.  With
+    ``key`` the score is random, so points whose in-degree exceeds ``r`` get
+    a FRESH random subset per call (exploration); without it the smallest
+    src ids win (deterministic).  Missing slots carry -1."""
+    n, k = idx.shape
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                           (n, k)).reshape(-1)
+    dst = idx.reshape(-1).astype(jnp.int32)
+    if key is None:
+        score = src
+    else:
+        score = jax.random.permutation(key, src.shape[0]).astype(jnp.int32)
+    ds, _, ss = lax.sort((dst, score, src), num_keys=2)
+    e = ds.shape[0]
+    first = jnp.concatenate([jnp.ones((1,), bool), ds[1:] != ds[:-1]])
+    eidx = jnp.arange(e, dtype=jnp.int32)
+    run_start = lax.cummax(jnp.where(first, eidx, 0))
+    col = eidx - run_start
+    keep = col < r
+    return jnp.full((n + 1, r), -1, jnp.int32).at[
+        jnp.where(keep, ds, n), jnp.where(keep, col, 0)].set(
+        jnp.where(keep, ss, -1), mode="drop")[:n]
+
+
+def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
+               metric: str = "sqeuclidean", rounds: int = 1, *,
+               sample: int = 8, row_chunk: int = 64,
+               key: jax.Array | None = None,
+               x_full: jnp.ndarray | None = None,
+               idx_full: jnp.ndarray | None = None,
+               row_offset: int = 0, n_valid: int | None = None):
+    """Neighbor-of-neighbor refinement of an approximate kNN graph — the
+    TPU-regular form of NN-descent's local join (Dong et al., public
+    algorithm): pure sorts, gathers and fixed-shape distance tiles, no hash
+    tables, no data-dependent shapes.
+
+    Each round builds the UNDIRECTED sample neighborhood ``u(i)`` =
+    (``sample`` nearest out-neighbors) ∪ (``sample`` first in-neighbors) —
+    the reverse half lets points escape one-way graph regions — then
+    proposes the FULL k out-lists of everyone in ``u(i)`` (plus ``u(i)``
+    itself) as candidates (2s + 2s·k per row), exact re-ranks with the CLI
+    metric in row chunks, and keeps the smallest k per row.  Two measured
+    design points (20k x 784 blobs, k=90):
+
+    * expansion goes through FULL k out-lists, not sampled lists — sampled
+      u(u(i)) expansion saturates ~0.79 recall@90;
+    * the out-half of the gateway sample is half nearest / half RANDOM,
+      re-drawn per round — all-nearest gateways revisit the same 2-hop
+      horizon every round and stall (NN-descent's new-flag exploration,
+      in fixed-shape form).
+
+    This stage is BEYOND reference parity: the reference's projectKnn has no
+    refinement (``TsneHelpers.scala:93-160``), and banded Z-order rounds
+    alone collapse with N at fixed band width (measured at 60k x 784, k=90:
+    recall@90 = 0.29 at the reference-default 3 rounds, 0.76 even at 12
+    rounds — scripts/measure_recall.py sweep, README table), while a few
+    refine rounds recover high recall at less cost than more Z-order rounds.
+
+    ``x_full``/``idx_full``/``row_offset`` support the sharded form: ``x``,
+    ``idx``/``dist`` are then the LOCAL row shard while gathers index the
+    all-gathered global arrays (``parallel/knn.project_knn_sharded``), and
+    the reverse sample is built from the global graph.  ``n_valid`` masks
+    candidates at or beyond it (mesh padding rows must never be proposed).
+    """
+    nloc, k = idx.shape
+    xf = x if x_full is None else x_full
+    gidx = idx if idx_full is None else idx_full
+    n_full = xf.shape[0]
+    s = min(sample, k)
+    f = metric_fn(metric)
+    c = min(row_chunk, nloc)
+    nchunks = math.ceil(nloc / c)
+    pad = nchunks * c - nloc
+    rows_g = row_offset + jnp.arange(nloc, dtype=jnp.int32)
+    self_ids = jnp.arange(n_full, dtype=jnp.int32)
+    if key is None:
+        key = jax.random.key(7)
+
+    for rnd in range(max(0, rounds)):
+        # out-gateways: nearest s/2 always + random rest, re-drawn per round
+        # (fixed-shape exploration: random scores, nearest slots forced to
+        # -inf so a bottom-s pick keeps them)
+        key, gkey, vkey = jax.random.split(key, 3)
+        if s < k:
+            score = jax.random.uniform(gkey, gidx.shape)
+            score = score.at[:, : max(1, s // 2)].set(-jnp.inf)
+            gate = jnp.take_along_axis(
+                gidx, jnp.argsort(score, axis=1)[:, :s], axis=1)
+        else:
+            gate = gidx[:, :s]
+        # undirected gateway set of EVERY point (global graph), in-half drawn
+        # randomly per round; missing reverse slots become the point's own
+        # id, which self-masking and dedup silently absorb downstream
+        rev = _reverse_sample(gidx, s, key=vkey)
+        rev = jnp.where(rev < 0, self_ids[:, None], rev)
+        u = jnp.concatenate([gate, rev], axis=1)  # [N, 2s]
+
+        ip = jnp.pad(idx, ((0, pad), (0, 0)))
+        dp = jnp.pad(dist, ((0, pad), (0, 0)), constant_values=jnp.inf)
+        rp = jnp.pad(rows_g, (0, pad))
+
+        def one_chunk(args):
+            ic, dc, rc = args                    # [c, k], [c, k], [c]
+            mine = u[rc]                         # [c, 2s]
+            cand = jnp.concatenate(
+                [mine, gidx[mine].reshape(c, -1)], axis=1)  # [c, 2s(1+k)]
+            xr = xf[rc]                          # [c, dim]
+            xc = xf[cand]                        # [c, C, dim]
+            dd = f(xr[:, None, :], xc)
+            dd = jnp.where(cand == rc[:, None], jnp.inf, dd)
+            if n_valid is not None:
+                dd = jnp.where(cand >= n_valid, jnp.inf, dd)
+            return _dedup_smallest(
+                jnp.concatenate([ic, cand], axis=1),
+                jnp.concatenate([dc, dd], axis=1), k)
+
+        ni, nd = lax.map(one_chunk, (ip.reshape(nchunks, c, k),
+                                     dp.reshape(nchunks, c, k),
+                                     rp.reshape(nchunks, c)))
+        idx = ni.reshape(-1, k)[:nloc]
+        dist = nd.reshape(-1, k)[:nloc]
+        if idx_full is None:
+            gidx = idx  # single-device: next round sees the refined graph
+    return idx, dist
 
 
 def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                 rounds: int = 3, key: jax.Array | None = None,
-                *, proj_dims: int = 3, block: int = 1024):
+                *, proj_dims: int = 3, block: int = 1024,
+                start_round: int = 0):
     """Approximate kNN via random-shift Z-order rounds + exact banded re-rank.
 
     Reference ``projectKnn`` (``TsneHelpers.scala:93-160``): 1 unshifted round +
@@ -250,7 +401,10 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
         return dist, idx
 
     dists, idxs = [], []
-    for it in range(max(1, rounds)):
+    # start_round > 0 marks continuation rounds (hybrid cycles): they must
+    # all be SHIFTED — restarting at the unshifted round 0 would recompute
+    # the seed's identical permutation on dim <= proj_dims inputs
+    for it in range(start_round, start_round + max(1, rounds)):
         key, rkey = jax.random.split(key)
         d, i = one_round(it, rkey)
         dists.append(d)
@@ -259,11 +413,46 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
     return merge_rounds(dists, idxs, k)
 
 
+#: fresh Z-order rounds merged in before each refine round of the hybrid
+#: plan — they inject INDEPENDENT global candidates that break NN-descent's
+#: local optimum (measured at 20k x 784, k=90: pure refine reaches 0.93@2
+#: rounds where interleaved reaches 0.98, and 0.99 at 3 — scripts/
+#: measure_recall.py)
+ZORDER_PER_CYCLE = 2
+
+
+def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
+                        seed_rounds: int = 3, cycles: int = 2,
+                        key: jax.Array | None = None):
+    """The hybrid high-recall plan: a Z-order seed graph, then ``cycles`` of
+    (2 fresh Z-order rounds merged in + 1 NN-descent refine round).
+
+    Exploration comes from two independent mechanisms — fresh random
+    projections re-partition space globally each cycle, the local join
+    exploits graph structure locally — and the combination dominates either
+    alone on data where distances concentrate (the isotropic-cluster worst
+    case the bench uses).  All stages share the one (idx, dist) top-k state
+    via :func:`merge_rounds`."""
+    if key is None:
+        key = jax.random.key(0)
+    key, skey = jax.random.split(key)
+    idx, dist = knn_project(x, k, metric, seed_rounds, skey)
+    for cyc in range(max(0, cycles)):
+        key, zkey, rkey = jax.random.split(key, 3)
+        iz, dz = knn_project(x, k, metric, ZORDER_PER_CYCLE, zkey,
+                             start_round=seed_rounds
+                             + cyc * ZORDER_PER_CYCLE)
+        idx, dist = merge_rounds([dist, dz], [idx, iz], k)
+        idx, dist = knn_refine(x, idx, dist, metric, rounds=1, key=rkey)
+    return idx, dist
+
+
 def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
         *, blocks: int = 8, rounds: int | None = None,
-        key: jax.Array | None = None):
+        refine: int | None = None, key: jax.Array | None = None):
     """Dispatch mirroring ``Tsne.scala:74-79``.  ``rounds=None`` resolves via
-    :func:`pick_knn_rounds` (N-scaled recall policy)."""
+    :func:`pick_knn_rounds`, ``refine=None`` via :func:`pick_knn_refine`
+    (the N-scaled recall policy; refinement applies to ``project`` only)."""
     if method == "bruteforce":
         return knn_bruteforce(x, k, metric)
     if method == "partition":
@@ -271,5 +460,9 @@ def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
     if method == "project":
         if rounds is None:
             rounds = pick_knn_rounds(x.shape[0])
+        if refine is None:
+            refine = pick_knn_refine(x.shape[0])
+        if refine > 0:
+            return knn_project_refined(x, k, metric, rounds, refine, key)
         return knn_project(x, k, metric, rounds, key)
     raise ValueError(f"Knn method '{method}' not defined")

@@ -124,7 +124,8 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
                         n_global: int, metric: str = "sqeuclidean",
                         rounds: int = 3, key: jax.Array | None = None, *,
                         axis_name: str = "points", proj_dims: int = 3,
-                        block: int = 1024):
+                        block: int = 1024, refine_rounds: int = 0,
+                        refine_sample: int = 8):
     """Sharded approximate kNN: random-shift Morton rounds + banded re-rank,
     with the band work split across the mesh by sorted block range.
 
@@ -134,6 +135,17 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
     single-task global sorter (:140-144) becomes replicated-compute Morton
     keys on an all-gathered [N, proj_dims] projection plus a per-device slice
     of the band sweep.
+
+    ``refine_rounds`` > 0 then runs that many HYBRID refine cycles — the
+    sharded form of :func:`tsne_flink_tpu.ops.knn.knn_project_refined`: each
+    cycle merges 2 fresh sharded Z-order rounds (independent global
+    candidates) and runs one NN-descent round
+    (:func:`tsne_flink_tpu.ops.knn.knn_refine`) on the local row shard with
+    a per-cycle PRNG key.  Each cycle all-gathers the current [N, k] graph
+    (tiny next to the [N, dim] input this function already gathers), and
+    every device re-ranks its own rows' local-join candidates — the
+    recall-recovery stage banded Z-order cannot provide at large N
+    (measured: scripts/measure_recall.py).
     """
     n_local, dim = x_local.shape
     k = _clamp_k(k, n_global)
@@ -220,4 +232,28 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
         dists.append(d)
         idxs.append(i)
 
-    return merge_rounds(dists, idxs, k)
+    idx, dist = merge_rounds(dists, idxs, k)
+
+    from tsne_flink_tpu.ops.knn import ZORDER_PER_CYCLE, knn_refine
+    row_offset = me * n_local
+    it = max(1, rounds)
+    for _ in range(max(0, refine_rounds)):
+        # fresh sharded Z-order rounds: independent global candidates that
+        # break the local join out of its optimum (knn_project_refined)
+        for _z in range(ZORDER_PER_CYCLE):
+            key, zkey = jax.random.split(key)
+            d2, i2 = one_round(it, zkey)  # it > 0: shifted grid
+            it += 1
+            idx, dist = merge_rounds([dist, d2], [idx, i2], k)
+        key, rkey = jax.random.split(key)
+        idx_full = lax.all_gather(idx, axis_name, tiled=True)  # [npts, k]
+        # mesh-padding rows must not inject reverse edges: pin them to
+        # self-loops (absorbed by self-masking/dedup inside the refine)
+        idx_full = jnp.where(gids[:, None] < n_global, idx_full,
+                             gids[:, None])
+        idx, dist = knn_refine(x_local, idx, dist, metric, rounds=1,
+                               sample=refine_sample, key=rkey,
+                               x_full=x_full,
+                               idx_full=idx_full, row_offset=row_offset,
+                               n_valid=n_global)
+    return idx, dist

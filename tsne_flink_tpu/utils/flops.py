@@ -42,18 +42,23 @@ _CPU_CORE_PEAK = 32e9
 
 def peak_flops(backend: str, device_kind: str = "", devices: int = 1,
                cpu_cores: int | None = None):
-    """Return (peak_flops_total, basis_string) for `devices` devices."""
+    """Return (peak_flops_total, basis_string) for `devices` devices.
+
+    Unrecognized backends (e.g. gpu) return ``(None, ...)`` — the caller
+    must report MFU as unknown rather than dividing by a made-up peak."""
     if backend == "tpu":
         kind = device_kind.lower()
         for tag, peak in _TPU_PEAK.items():
             if tag in kind:
                 return peak * devices, f"bf16 peak {peak/1e12:.0f}TF x {devices} ({device_kind})"
         return 197e12 * devices, f"bf16 peak 197TF x {devices} (unknown TPU kind '{device_kind}')"
-    if cpu_cores is None:
-        import os
-        cpu_cores = os.cpu_count() or 1
-    return _CPU_CORE_PEAK * cpu_cores, (
-        f"nominal f32 {_CPU_CORE_PEAK/1e9:.0f}GF/core x {cpu_cores} cores")
+    if backend == "cpu":
+        if cpu_cores is None:
+            import os
+            cpu_cores = os.cpu_count() or 1
+        return _CPU_CORE_PEAK * cpu_cores, (
+            f"nominal f32 {_CPU_CORE_PEAK/1e9:.0f}GF/core x {cpu_cores} cores")
+    return None, f"unrecognized backend '{backend}' — no peak model, MFU unknown"
 
 
 def distance_tile_flops(rows: float, cols: float, d: float) -> float:
@@ -63,7 +68,8 @@ def distance_tile_flops(rows: float, cols: float, d: float) -> float:
 
 
 def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
-              proj_dims: int = 3, block: int = 1024) -> float:
+              proj_dims: int = 3, block: int = 1024,
+              refine_rounds: int = 0, refine_sample: int = 8) -> float:
     """kNN stage FLOPs (ops/knn.py).
 
     * bruteforce / partition: the full N x N distance computation (the block
@@ -73,6 +79,12 @@ def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
       [b, b+2k] x d tile, i.e. n * band * d work per round
       (ops/knn.py:218-244).  Sorts/merges are O(N log N) — negligible next to
       the d=784 matmuls — and excluded.
+    * hybrid refinement (knn_project_refined): each of the ``refine_rounds``
+      cycles adds ZORDER_PER_CYCLE more Z-order rounds plus one NN-descent
+      round — per refine round each row exact-ranks 2s·(1 + k) local-join
+      candidates (the full k out-lists of its fwd∪rev sample neighborhood)
+      at ~3d ops per pair (elementwise distance, no shared-column matmul),
+      plus the edge-list sort for the reverse sample (~2*n*k*log2(2nk) ops).
     """
     if method in ("bruteforce", "partition"):
         return distance_tile_flops(n, n, d)
@@ -83,7 +95,18 @@ def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
         if d > m:
             per_round += 2.0 * n * d * m
         per_round += distance_tile_flops(n, band, d)
-        return rounds * per_round
+        zrounds = rounds
+        total = 0.0
+        if refine_rounds > 0:
+            from tsne_flink_tpu.ops.knn import ZORDER_PER_CYCLE
+            zrounds += refine_rounds * ZORDER_PER_CYCLE
+            s = min(refine_sample, k)
+            cand = 2 * s * (1 + k)
+            per_ref = (n * cand * 3.0 * d
+                       + 2.0 * n * k * math.log2(max(2 * n * k, 2)))
+            total += refine_rounds * per_ref
+        total += zrounds * per_round
+        return total
     raise ValueError(f"Knn method '{method}' not defined")
 
 
