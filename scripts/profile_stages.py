@@ -23,7 +23,7 @@ def main():
 
     from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
     from tsne_flink_tpu.ops.affinities import affinity_pipeline
-    from tsne_flink_tpu.ops.knn import knn_project
+    from tsne_flink_tpu.ops.knn import knn as knn_dispatch
     from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
@@ -35,8 +35,9 @@ def main():
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=0.5,
                      repulsion=repulsion, row_chunk=4096)
 
-    knn_fn = jax.jit(lambda xx: knn_project(xx, k, rounds=3,
-                                            key=jax.random.key(0)))
+    # the auto plan the CLI/bench run: Z-order seed + hybrid refine cycles
+    knn_fn = jax.jit(lambda xx: knn_dispatch(xx, k, "project",
+                                             key=jax.random.key(0)))
     _, c_knn = t(lambda: jax.block_until_ready(knn_fn(x)))
     (idx, dist), r_knn = t(lambda: jax.block_until_ready(knn_fn(x)))
     print(f"knn:        compile+run {c_knn:7.2f}s   steady {r_knn:7.2f}s")

@@ -126,6 +126,15 @@ def main():
                    "--perplexity", "4", "--iterations", "60"], env)
     results.append(("config4 distance-matrix 400k-class", n4, dt, out))
 
+    # config 4b (round 3): the same precomputed graph through the SPMD
+    # pipeline — the reference's distance-matrix input runs distributed
+    # (Tsne.scala:70,155-159), and since round 3 so does ours
+    dt, out = cli(["--input", p("c4.csv"), "--output", p("c4b_out.csv"),
+                   "--dimension", "100", "--knnMethod", "bruteforce",
+                   "--inputDistanceMatrix", "--neighbors", "12",
+                   "--perplexity", "4", "--iterations", "60", "--spmd"], env)
+    results.append(("config4b distance-matrix --spmd", n4, dt, out))
+
     # config 5: 1.3M multi-host analog — full SPMD pipeline (single process
     # here; tests/test_multiprocess.py covers the true 2-process run)
     n5 = max(500, int(1_300_000 * s * 0.01))

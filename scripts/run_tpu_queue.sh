@@ -23,6 +23,9 @@ STEP_TIMEOUT=1800 step bench_60k_exact python bench.py 60000 300 exact
 STEP_TIMEOUT=1800 step bench_60k_bh python bench.py 60000 300 bh
 # 4. the 1M north star
 STEP_TIMEOUT=2400 step bench_1m_fft python bench.py 1000000 300 fft
+# 4b. the full sharded pipeline (project+refine kNN, alltoall sym, fft) at 1M
+STEP_TIMEOUT=2400 step large_n_spmd env TSNE_FORCE_CPU=0 \
+  python scripts/run_large_n.py 1000000 784 300 30
 # 5. recall at bench shape
 STEP_TIMEOUT=1800 step recall_60k python scripts/measure_recall.py 60000 784 90 --sweep
 # 6. all five BASELINE configs at full size

@@ -8,7 +8,12 @@ independent yardstick.  Compares, on the same blobs dataset:
 * trustworthiness (sklearn.manifold.trustworthiness, k=12) — the standard
   neighborhood-preservation score in [0, 1]
 
-Usage: python scripts/validate_quality.py [n] [dim] [repulsion]
+Usage: python scripts/validate_quality.py [n] [dim] [repulsion] [knn_method]
+       python scripts/validate_quality.py --digits [repulsion]
+
+--digits runs on sklearn's bundled handwritten-digits set (1797 x 64) — a
+REAL no-egress dataset with manifold structure, complementing the synthetic
+blobs (VERDICT r2 next-step #7).
 """
 
 import os
@@ -29,14 +34,24 @@ jax.config.update("jax_platforms",
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1500
-    d = int(sys.argv[2]) if len(sys.argv) > 2 else 50
-    repulsion = sys.argv[3] if len(sys.argv) > 3 else "exact"
-
-    rng = np.random.default_rng(0)
-    centers = rng.normal(size=(8, d)) * 6.0
-    labels = rng.integers(0, 8, n)
-    x = (centers[labels] + rng.normal(size=(n, d))).astype(np.float32)
+    if "--digits" in sys.argv:
+        from sklearn.datasets import load_digits
+        x = load_digits().data.astype(np.float32)
+        n, d = x.shape
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        repulsion = args[0] if args else "exact"
+        knn_method = "bruteforce"
+        label = f"digits n={n} d={d}"
+    else:
+        n = int(sys.argv[1]) if len(sys.argv) > 1 else 1500
+        d = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+        repulsion = sys.argv[3] if len(sys.argv) > 3 else "exact"
+        knn_method = sys.argv[4] if len(sys.argv) > 4 else "bruteforce"
+        rng = np.random.default_rng(0)
+        centers = rng.normal(size=(8, d)) * 6.0
+        labels = rng.integers(0, 8, n)
+        x = (centers[labels] + rng.normal(size=(n, d))).astype(np.float32)
+        label = f"blobs n={n} d={d}"
 
     from sklearn.manifold import TSNE as SkTSNE, trustworthiness
 
@@ -51,14 +66,14 @@ def main():
 
     t0 = time.time()
     ours = TSNE(perplexity=30.0, n_iter=1000, repulsion=repulsion,
-                knn_method="bruteforce", random_state=0)
+                knn_method=knn_method, random_state=0)
     y_us = ours.fit_transform(x)
     t_us = time.time() - t0
 
     tw_sk = trustworthiness(x, y_sk, n_neighbors=12)
     tw_us = trustworthiness(x, y_us, n_neighbors=12)
 
-    print(f"n={n} d={d} repulsion={repulsion}")
+    print(f"{label} repulsion={repulsion} knn={knn_method}")
     print(f"sklearn : KL={sk.kl_divergence_:.4f}  trustworthiness={tw_sk:.4f}"
           f"  ({t_sk:.1f}s)")
     print(f"ours    : KL={ours.kl_divergence_:.4f}  "

@@ -175,6 +175,22 @@ def test_cli_distance_matrix_mode(tmp_path):
     assert np.loadtxt(out, delimiter=",", ndmin=2).shape == (30, 3)
 
 
+def test_cli_bfloat16_end_to_end(tmp_path):
+    # --dtype bfloat16 (the MXU-native dtype) must run the whole pipeline
+    # and emit finite embeddings; precision is coarse by design
+    tmp = str(tmp_path)
+    path, _ = blob_csv(tmp, n=40, d=6)
+    out = os.path.join(tmp, "out_bf16.csv")
+    rc = main(["--input", path, "--output", out, "--dimension", "6",
+               "--knnMethod", "bruteforce", "--perplexity", "5",
+               "--iterations", "30", "--dtype", "bfloat16",
+               "--loss", os.path.join(tmp, "l.txt")])
+    assert rc == 0
+    rows = np.loadtxt(out, delimiter=",", ndmin=2)
+    assert rows.shape == (40, 3)
+    assert np.isfinite(rows).all()
+
+
 def test_cli_distance_matrix_spmd(tmp_path):
     # --inputDistanceMatrix now composes with --spmd (VERDICT r2 missing #4:
     # the reference's distance-matrix input runs in its only — distributed —

@@ -77,6 +77,35 @@ def test_project_knn_sharded_recall_and_exactness():
     assert hits / (n * k) > 0.5
 
 
+def test_project_knn_sharded_hybrid_refine_improves_recall():
+    # the sharded hybrid plan (fresh Z rounds + NN-descent per cycle) must
+    # lift recall over the plain banded seed and keep exact distances
+    n, d, k = 200, 16, 8
+    x = blobs(n, d, seed=9)
+    key = jax.random.key(5)
+
+    def rec(idx_g):
+        idx_true, _ = knn_bruteforce(jnp.asarray(x), k)
+        hits = sum(len(set(idx_g[i]) & set(np.asarray(idx_true)[i]))
+                   for i in range(n))
+        return hits / (n * k)
+
+    idx0, _ = shard_run(
+        lambda xl: project_knn_sharded(xl, k, 8, n, rounds=2, key=key,
+                                       block=16), x, n)
+    idx1, dist1 = shard_run(
+        lambda xl: project_knn_sharded(xl, k, 8, n, rounds=2, key=key,
+                                       block=16, refine_rounds=2), x, n)
+    r0, r1 = rec(idx0), rec(idx1)
+    assert r1 > r0, (r0, r1)
+    assert r1 >= 0.9, (r0, r1)
+    # refined distances are still exact metric values
+    finite = np.isfinite(dist1)
+    want = ((x[:, None, :] - x[idx1]) ** 2).sum(-1)
+    np.testing.assert_allclose(np.where(finite, dist1, 0.0),
+                               np.where(finite, want, 0.0), atol=1e-9)
+
+
 def test_spmd_pipeline_matches_single_device_composition():
     n, d, k = 44, 7, 9
     x = blobs(n, d, seed=4)

@@ -244,43 +244,46 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     nloc, k = idx.shape
     xf = x if x_full is None else x_full
     gidx = idx if idx_full is None else idx_full
-    n_full = xf.shape[0]
     s = min(sample, k)
     f = metric_fn(metric)
     c = min(row_chunk, nloc)
     nchunks = math.ceil(nloc / c)
     pad = nchunks * c - nloc
     rows_g = row_offset + jnp.arange(nloc, dtype=jnp.int32)
-    self_ids = jnp.arange(n_full, dtype=jnp.int32)
     if key is None:
         key = jax.random.key(7)
 
     for rnd in range(max(0, rounds)):
-        # out-gateways: nearest s/2 always + random rest, re-drawn per round
-        # (fixed-shape exploration: random scores, nearest slots forced to
-        # -inf so a bottom-s pick keeps them)
+        # out-gateways for the LOCAL rows only (the expansion below reads
+        # u only at this shard's rows — building gateways for all N would
+        # replicate an [N, k] sort per device per cycle): nearest s/2 always
+        # + random rest, re-drawn per round (fixed-shape exploration: random
+        # scores, nearest slots forced to -inf so a bottom-s pick keeps them)
         key, gkey, vkey = jax.random.split(key, 3)
+        gidx_loc = gidx[rows_g]                       # [nloc, k]
         if s < k:
-            score = jax.random.uniform(gkey, gidx.shape)
+            score = jax.random.uniform(gkey, gidx_loc.shape)
             score = score.at[:, : max(1, s // 2)].set(-jnp.inf)
             gate = jnp.take_along_axis(
-                gidx, jnp.argsort(score, axis=1)[:, :s], axis=1)
+                gidx_loc, jnp.argsort(score, axis=1)[:, :s], axis=1)
         else:
-            gate = gidx[:, :s]
-        # undirected gateway set of EVERY point (global graph), in-half drawn
-        # randomly per round; missing reverse slots become the point's own
-        # id, which self-masking and dedup silently absorb downstream
-        rev = _reverse_sample(gidx, s, key=vkey)
-        rev = jnp.where(rev < 0, self_ids[:, None], rev)
-        u = jnp.concatenate([gate, rev], axis=1)  # [N, 2s]
+            gate = gidx_loc[:, :s]
+        # in-half of the gateway set, drawn randomly per round; the edge sort
+        # inside is genuinely global (in-neighbors of local rows can source
+        # anywhere), only the rows are sliced.  Missing reverse slots become
+        # the point's own id, which self-masking and dedup silently absorb
+        rev = _reverse_sample(gidx, s, key=vkey)[rows_g]
+        rev = jnp.where(rev < 0, rows_g[:, None], rev)
+        u_loc = jnp.concatenate([gate, rev], axis=1)  # [nloc, 2s]
 
         ip = jnp.pad(idx, ((0, pad), (0, 0)))
         dp = jnp.pad(dist, ((0, pad), (0, 0)), constant_values=jnp.inf)
-        rp = jnp.pad(rows_g, (0, pad))
+        # chunk padding rows must stay in-shard: local index 0's global id
+        rp = jnp.pad(rows_g, (0, pad), constant_values=row_offset)
 
         def one_chunk(args):
             ic, dc, rc = args                    # [c, k], [c, k], [c]
-            mine = u[rc]                         # [c, 2s]
+            mine = u_loc[rc - row_offset]        # [c, 2s]
             cand = jnp.concatenate(
                 [mine, gidx[mine].reshape(c, -1)], axis=1)  # [c, 2s(1+k)]
             xr = xf[rc]                          # [c, dim]
