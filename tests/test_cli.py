@@ -277,3 +277,22 @@ def test_estimator_api_fit_transform():
     y2 = TSNE(perplexity=5.0, n_iter=60, random_state=4,
               knn_method="partition").fit_transform(x)
     np.testing.assert_array_equal(y, y2)
+
+
+def test_estimator_api_spmd():
+    # spmd=True routes through SpmdPipeline on the device mesh, same surface
+    import numpy as np
+
+    from tsne_flink_tpu import TSNE
+
+    rng = np.random.default_rng(1)
+    centers = rng.normal(size=(3, 8)) * 5.0
+    x = centers[rng.integers(0, 3, 52)] + rng.normal(size=(52, 8))
+    est = TSNE(perplexity=5.0, n_iter=40, random_state=4,
+               knn_method="bruteforce", repulsion="exact", spmd=True,
+               devices=8)
+    y = est.fit_transform(x)
+    assert y.shape == (52, 2)
+    assert np.isfinite(y).all()
+    assert np.isfinite(est.kl_divergence_)
+    assert est.kl_trace_.shape == (4,)

@@ -31,7 +31,9 @@ class TSNE:
                  theta: float | None = None, repulsion: str = "auto",
                  knn_method: str = "bruteforce", neighbors: int | None = None,
                  knn_blocks: int = 8, knn_iterations: int | None = None,
-                 knn_refine: int | None = None, random_state: int = 0):
+                 knn_refine: int | None = None, random_state: int = 0,
+                 spmd: bool = False, devices: int | None = None,
+                 sym_mode: str = "replicated"):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -51,6 +53,12 @@ class TSNE:
         self.knn_iterations = knn_iterations
         self.knn_refine = knn_refine
         self.random_state = random_state
+        # spmd=True runs the whole job as ONE sharded program over a
+        # `devices`-wide point mesh (the CLI's --spmd / SpmdPipeline) —
+        # required once N outgrows one chip
+        self.spmd = spmd
+        self.devices = devices
+        self.sym_mode = sym_mode
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
@@ -70,14 +78,34 @@ class TSNE:
                                      self.theta_explicit_))
 
     def fit(self, x, y=None) -> "TSNE":
+        import jax
         import jax.numpy as jnp
 
         x = jnp.asarray(x)
         cfg = self._config(x.shape[0])
-        y, losses = tsne_embed(
-            x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
-            knn_blocks=self.knn_blocks, knn_iterations=self.knn_iterations,
-            knn_refine=self.knn_refine, seed=self.random_state)
+        if self.spmd:
+            from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+
+            n, d = x.shape
+            k = (self.neighbors if self.neighbors is not None
+                 else 3 * int(cfg.perplexity))
+            pipe = SpmdPipeline(cfg, n, d, k, knn_method=self.knn_method,
+                                knn_rounds=self.knn_iterations,
+                                knn_refine=self.knn_refine,
+                                sym_mode=self.sym_mode,
+                                n_devices=self.devices)
+            y, losses = pipe(x, jax.random.key(self.random_state))
+            if jax.process_count() > 1:
+                # multi-controller: __call__ returns the PADDED global array
+                # (non-addressable here); gather and slice like the CLI does
+                from jax.experimental import multihost_utils
+                y = multihost_utils.process_allgather(y, tiled=True)[:n]
+        else:
+            y, losses = tsne_embed(
+                x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
+                knn_blocks=self.knn_blocks,
+                knn_iterations=self.knn_iterations,
+                knn_refine=self.knn_refine, seed=self.random_state)
         self.embedding_ = np.asarray(y)
         self.kl_trace_ = np.asarray(losses)
         self.kl_divergence_ = (float(self.kl_trace_[-1])

@@ -56,15 +56,20 @@ def pick_knn_rounds(n: int) -> int:
     knnIterations default (Tsne.scala:61).  This is THE auto policy — every
     entry point (CLI, estimator API, bench, SpmdPipeline) resolves
     ``rounds=None`` through it, paired with :func:`pick_knn_refine`."""
-    return 3  # seed only at any N; hybrid cycles carry recall from here
+    if 4000 < n <= 8000:
+        return 6  # measured 0.98 recall@90 at 8k with 6 plain rounds —
+        # cheaper than refine cycles while the band still covers ~1/8 of N
+    return 3  # band covers small N; hybrid cycles carry recall at large N
 
 
 def pick_knn_refine(n: int) -> int:
     """Auto hybrid refine cycles (each = 2 fresh Z-order rounds + 1
     NN-descent round) after the seed: none needed while the band covers a
-    large fraction of N; grows gently with N (measured operating points:
-    scripts/measure_recall.py, README table — 20k x 784: 0.98@2, 0.99@3)."""
-    if n <= 4000:
+    large fraction of N (plain Z-order rounds are cheaper there — see
+    :func:`pick_knn_rounds`); grows gently with N beyond that (measured
+    operating points: scripts/measure_recall.py, README table — 20k x 784:
+    0.98@2, 0.99@3; 60k x 784: 0.95@4)."""
+    if n <= 8000:
         return 0
     return max(2, min(5, math.ceil(math.log2(n / 4000))))
 
