@@ -187,10 +187,17 @@ def main():
         affinity_flops, knn_flops, optimize_flops, peak_flops)
     backend = jax.default_backend()
     s = int(jidx.shape[1])  # true symmetrized row width the optimizer ran
+    # ask the optimizer which attraction layout it actually launched so the
+    # FLOP model counts the launched pairs (utils/flops.py) — single- AND
+    # multi-device (the decision lives in ONE place: affinities.plan_edges
+    # via ShardedOptimizer.attraction_plan)
+    layout, pairs, _ = runner.attraction_plan(jidx, jval)
+    use_edges = layout == "edges"
     f_knn = knn_flops(n, int(x_np.shape[1]), k, "project", rounds=rounds,
                       refine_rounds=refine)
     f_aff = affinity_flops(n, k)
     f_opt = optimize_flops(n, s, 2, iters, repulsion,
+                           nnz_pairs=pairs if use_edges else None,
                            mpad=8 if backend == "tpu" else 3)
     flops = f_knn + f_aff + f_opt
     kind = jax.devices()[0].device_kind if backend == "tpu" else ""
@@ -214,6 +221,8 @@ def main():
         "n": n, "iterations": iters, "repulsion": repulsion,
         "theta": cfg.theta,
         "knn_rounds": rounds, "knn_refine": refine, "sym_width": s,
+        "attraction": layout,
+        "attraction_pairs": pairs,
     }))
 
 

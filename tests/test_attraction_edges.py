@@ -1,0 +1,178 @@
+"""Flat COO edge-layout attraction (ops/affinities.assemble_edges +
+models/tsne._attractive_forces_edges).
+
+The padded row layout sizes every row to the max symmetrized degree; on
+hub-heavy graphs that is ~20x more launched pairs than the graph has edges
+(MNIST-60k, k=90: sym_width 3584 vs mean degree ~150).  The edge layout must
+be numerically interchangeable with the row layout — same forces, same loss —
+on one device, on the 8-device mesh, and through the fused SpmdPipeline's
+escalation path (the reference computes attraction per sparse row,
+TsneHelpers.scala:290-302; both layouts realize that same sum)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set, optimize
+from tsne_flink_tpu.ops.affinities import (assemble_edges, edge_count,
+                                           joint_distribution,
+                                           pairwise_affinities)
+from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+
+
+def _graph(n=160, k=8, seed=0, hub=True):
+    """kNN-shaped graph; with ``hub`` most rows also point at point 0, so the
+    symmetrized row 0 is far wider than 2k (forces width escalation)."""
+    rng = np.random.default_rng(seed)
+    idx = np.empty((n, k), np.int64)
+    for i in range(n):
+        idx[i] = rng.choice([j for j in range(n) if j != i], k, replace=False)
+        if hub and i > 0:
+            idx[i, 0] = 0
+    dist = rng.random((n, k)) + 0.05
+    return jnp.asarray(idx, jnp.int32), jnp.asarray(dist)
+
+
+def _rows(idx, dist, perplexity=5.0):
+    p = pairwise_affinities(dist, perplexity)
+    return joint_distribution(idx, p)
+
+
+def test_assemble_edges_roundtrip():
+    idx, dist = _graph(60, 5)
+    jidx, jval = _rows(idx, dist)
+    e_pad = edge_count(jval, multiple=8)
+    src, dst, val = jax.jit(partial(assemble_edges, e_pad=e_pad))(jidx, jval)
+    src, dst, val = map(np.asarray, (src, dst, val))
+    nnz = int(np.sum(np.asarray(jval) > 0))
+    assert nnz <= e_pad
+    # padding tail carries zero values and keeps src ascending END TO END
+    # (indices_are_sorted=True is a guarantee to XLA, tail included)
+    n_rows = jidx.shape[0]
+    assert (val[nnz:] == 0).all() and (src[nnz:] == n_rows - 1).all()
+    assert (np.diff(src) >= 0).all()
+    # the edge multiset equals the row-layout nonzeros, in row-major order
+    ji, jv = np.asarray(jidx), np.asarray(jval)
+    exp = [(i, ji[i, s], jv[i, s]) for i in range(ji.shape[0])
+           for s in range(ji.shape[1]) if jv[i, s] > 0]
+    got = list(zip(src[:nnz], dst[:nnz], val[:nnz]))
+    assert [(a, b) for a, b, _ in got] == [(a, b) for a, b, _ in exp]
+    np.testing.assert_allclose([v for *_, v in got], [v for *_, v in exp],
+                               rtol=0, atol=0)
+    # src ascending (consumers rely on indices_are_sorted=True)
+    assert (np.diff(src[:nnz]) >= 0).all()
+
+
+def test_optimize_edges_equals_rows_single_device():
+    """One step must agree to summation-order noise (~1e-12); a full run only
+    to a loose tolerance — the adaptive-gains sign test amplifies last-bit
+    differences exponentially over iterations (same chaos for the reference's
+    double-vs-double golden runs, TsneHelpersTestSuite.scala tolerances)."""
+    n = 180
+    idx, dist = _graph(n, 7, seed=1)
+    jidx, jval = _rows(idx, dist)
+    edges = assemble_edges(jidx, jval, edge_count(jval, multiple=8))
+    cfg = TsneConfig(iterations=30, repulsion="exact", exact_impl="xla")
+    st0 = init_working_set(jax.random.key(3), n, 2, jnp.float64)
+    run = jax.jit(partial(optimize, cfg=cfg))
+    one = jax.jit(partial(optimize, cfg=cfg, num_iters=1))
+    y1_rows, _ = one(st0, jidx, jval)
+    y1_edges, _ = one(st0, jidx, jval, edges=edges)
+    np.testing.assert_allclose(np.asarray(y1_edges.y), np.asarray(y1_rows.y),
+                               atol=1e-12)
+    y_rows, l_rows = run(st0, jidx, jval)
+    y_edges, l_edges = run(st0, jidx, jval, edges=edges)
+    np.testing.assert_allclose(np.asarray(y_edges.y), np.asarray(y_rows.y),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(l_edges), np.asarray(l_rows),
+                               atol=1e-6)
+
+
+def test_sharded_optimizer_edge_layout_matches_rows():
+    n = 131  # non-divisible by 8: exercises padded rows in the edge build
+    idx, dist = _graph(n, 6, seed=2)
+    jidx, jval = _rows(idx, dist)
+    outs = {}
+    for mode in ("rows", "edges"):
+        cfg = TsneConfig(iterations=25, repulsion="exact", exact_impl="xla",
+                         attraction=mode)
+        st = init_working_set(jax.random.key(0), n, 2, jnp.float64)
+        r = ShardedOptimizer(cfg, n, 8)
+        st, losses = r(st, jidx, jval)
+        outs[mode] = (np.asarray(st.y), np.asarray(losses))
+    np.testing.assert_allclose(outs["edges"][0], outs["rows"][0], atol=1e-5)
+    np.testing.assert_allclose(outs["edges"][1], outs["rows"][1], atol=1e-6)
+
+
+def test_fused_pipeline_escalation_uses_edges_and_matches_rows():
+    """Hub graph through the fused SpmdPipeline: the auto sym_width guess
+    overflows, the recompiled program sizes the flat edge layout from the
+    measured nnz, and the result matches a pinned-wide rows-layout run."""
+    n, k = 96, 6
+    idx, dist = _graph(n, k, seed=4, hub=True)
+    cfg_e = TsneConfig(iterations=10, repulsion="exact", exact_impl="xla")
+    pipe = SpmdPipeline(cfg_e, n, 0, k, knn_method="precomputed",
+                        n_devices=8)
+    y_e, l_e = pipe((idx, dist), jax.random.key(7))
+    assert pipe._escalations >= 1, "hub graph must overflow the auto width"
+    assert pipe._edge_pad is not None, "escalated run must size the edge layout"
+
+    cfg_r = TsneConfig(iterations=10, repulsion="exact", exact_impl="xla",
+                       attraction="rows")
+    pipe_r = SpmdPipeline(cfg_r, n, 0, k, knn_method="precomputed",
+                          sym_width=pipe.sym_width, n_devices=8)
+    y_r, l_r = pipe_r((idx, dist), jax.random.key(7))
+    np.testing.assert_allclose(np.asarray(y_e), np.asarray(y_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l_e), np.asarray(l_r), atol=1e-6)
+
+
+def test_fused_pipeline_explicit_edges_without_escalation():
+    """attraction='edges' must engage the edge layout even when the auto
+    sym_width never overflows (uniform graph): the pipeline pays one
+    prep-only pass to size the pad, then matches the rows run."""
+    n, k = 80, 5
+    idx, dist = _graph(n, k, seed=6, hub=False)
+    cfg_e = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla",
+                       attraction="edges")
+    pipe = SpmdPipeline(cfg_e, n, 0, k, knn_method="precomputed", n_devices=8)
+    y_e, l_e = pipe((idx, dist), jax.random.key(2))
+    assert pipe._escalations == 0, "uniform graph must not overflow"
+    assert pipe._edge_pad is not None, "explicit edges must size the layout"
+
+    cfg_r = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla",
+                       attraction="rows")
+    pipe_r = SpmdPipeline(cfg_r, n, 0, k, knn_method="precomputed",
+                          n_devices=8)
+    y_r, l_r = pipe_r((idx, dist), jax.random.key(2))
+    np.testing.assert_allclose(np.asarray(y_e), np.asarray(y_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l_e), np.asarray(l_r), atol=1e-6)
+
+
+def test_fused_pipeline_edge_pad_refreshes_on_denser_graph():
+    """A pipeline whose _edge_pad was sized on one dataset must refresh it
+    when rerun on a denser graph of the same shapes — an undersized pad
+    would silently drop edges (code-review r3 finding)."""
+    n, k = 96, 6
+    idx1, dist1 = _graph(n, k, seed=4, hub=True)
+    cfg = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla")
+    pipe = SpmdPipeline(cfg, n, 0, k, knn_method="precomputed", n_devices=8)
+    pipe((idx1, dist1), jax.random.key(7))
+    pad1 = pipe._edge_pad
+    assert pad1 is not None
+
+    # denser: EVERY row points at the first 3 hubs -> far more edges
+    idx2 = np.asarray(idx1).copy()
+    idx2[3:, :3] = [0, 1, 2]
+    idx2 = jnp.asarray(idx2)
+    y2, l2 = pipe((idx2, dist1), jax.random.key(7))
+    assert pipe._edge_pad >= pad1
+
+    cfg_r = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla",
+                       attraction="rows")
+    fresh = SpmdPipeline(cfg_r, n, 0, k, knn_method="precomputed",
+                         sym_width=pipe.sym_width, n_devices=8)
+    y_r, l_r = fresh((idx2, dist1), jax.random.key(7))
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l2), np.asarray(l_r), atol=1e-6)

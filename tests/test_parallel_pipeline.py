@@ -314,8 +314,12 @@ def test_spmd_pipeline_auto_width_escalates_on_hub_rows():
     x = np.zeros((n, d), np.float32)
     for i in range(1, n):
         x[i, i - 1] = 1.0  # simplex: all pairwise sqrt(2) apart, 1 from hub
+    # attraction="rows": this test pins BIT-identity between the escalated
+    # and the pinned-width run, so both must use the same layout (the
+    # escalated run would otherwise switch to the flat edge layout, which is
+    # only summation-order-equal — tests/test_attraction_edges.py covers it)
     cfg = TsneConfig(iterations=6, repulsion="exact", row_chunk=8,
-                     perplexity=2.0)
+                     perplexity=2.0, attraction="rows")
     key = jax.random.key(3)
 
     pipe = SpmdPipeline(cfg, n, d, k, knn_method="bruteforce", n_devices=8)

@@ -62,6 +62,9 @@ class TsneConfig:
     min_gain: float = 0.01  # TsneHelpers.scala:386
     repulsion: str = "exact"  # exact | bh | fft
     exact_impl: str = "auto"  # auto | xla | pallas (auto: pallas on TPU f32)
+    attraction: str = "auto"  # auto | rows | edges (auto: edges when the true
+    # edge count is well under N x sym_width — hub-heavy graphs; see
+    # ops/affinities.assemble_edges)
     row_chunk: int = 2048
     bh_levels: int | None = None   # None: auto depth (repulsion_bh.py)
     bh_frontier: int = 32
@@ -141,8 +144,31 @@ def _attractive_forces(y_local, y_full, jidx, jval, metric, exag, z,
     return att.reshape(-1, m)[:nloc], jnp.sum(loss)
 
 
+def _attractive_forces_edges(y_local, y_full, src, dst, val, metric, exag, z):
+    """Edge-layout attraction: identical math to :func:`_attractive_forces`
+    but summed per-edge with a sorted ``segment_sum`` instead of per padded
+    row slot — work scales with the TRUE edge count, not N x max hub degree
+    (see :func:`tsne_flink_tpu.ops.affinities.assemble_edges`).  ``src`` holds
+    LOCAL row indices of this shard; ``dst`` indexes the gathered global
+    embedding."""
+    f = metric_fn(metric)
+    yi = y_local[src]                     # [E, m]
+    yj = y_full[dst]                      # [E, m]
+    q = 1.0 / (1.0 + f(yi, yj))           # [E]
+    pe = val * exag
+    w = pe * q
+    att = jax.ops.segment_sum(w[:, None] * (yi - yj), src,
+                              num_segments=y_local.shape[0],
+                              indices_are_sorted=True)
+    mask = val > 0
+    pe_safe = jnp.where(mask, pe, 1.0)
+    q_safe = jnp.where(mask, q, 1.0)
+    loss = jnp.sum(jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0))
+    return att, loss
+
+
 def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
-              axis_name=None, row_offset=0, valid_full=None):
+              axis_name=None, row_offset=0, valid_full=None, edges=None):
     """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317).
 
     ``valid_full`` is the GLOBAL point-validity mask (already gathered once,
@@ -180,8 +206,12 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
     else:
         raise ValueError(f"unknown repulsion backend '{cfg.repulsion}'")
     z = _psum(sq, axis_name)
-    att, loss = _attractive_forces(y_local, y_full, jidx, jval, cfg.metric,
-                                   exag, z, row_chunk=cfg.row_chunk)
+    if edges is not None:
+        att, loss = _attractive_forces_edges(y_local, y_full, *edges,
+                                             cfg.metric, exag, z)
+    else:
+        att, loss = _attractive_forces(y_local, y_full, jidx, jval, cfg.metric,
+                                       exag, z, row_chunk=cfg.row_chunk)
     loss = _psum(loss, axis_name)
     return att - rep / z, loss
 
@@ -225,7 +255,7 @@ def center_input(x: jnp.ndarray, axis_name=None, valid=None) -> jnp.ndarray:
 def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
              axis_name=None, row_offset=0, valid=None,
              start_iter=0, num_iters: int | None = None,
-             loss_carry=None):
+             loss_carry=None, edges=None):
     """Full 3-phase gradient descent as ONE compiled fori_loop.
 
     Returns (final TsneState, loss trace [iterations // 10]); trace slot t is
@@ -255,7 +285,7 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
         exag = jnp.where(i < cfg.exaggeration_end, alpha, one)
         grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
                                axis_name=axis_name, row_offset=row_offset,
-                               valid_full=valid_full)
+                               valid_full=valid_full, edges=edges)
         if valid is not None:
             grad = grad * valid[:, None].astype(grad.dtype)
         st = _update_embedding(st, grad, momentum, cfg)
@@ -292,6 +322,11 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
         rounds=knn_iterations, refine=knn_refine, key=kkey))(x)
     jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width)
     state = init_working_set(ikey, n, cfg.n_components, x.dtype)
+    edges = None
+    from tsne_flink_tpu.ops.affinities import assemble_edges, plan_edges
+    use_edges, e_pad = plan_edges(jidx, jval, cfg.attraction)
+    if use_edges:
+        edges = jax.jit(partial(assemble_edges, e_pad=e_pad))(jidx, jval)
     run = jax.jit(partial(optimize, cfg=cfg))
-    state, losses = run(state, jidx, jval)
+    state, losses = run(state, jidx, jval, edges=edges)
     return state.y, losses

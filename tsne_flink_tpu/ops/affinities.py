@@ -185,6 +185,78 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
     return tuple(out)
 
 
+def edge_count(jval: jnp.ndarray, multiple: int = 1024) -> int:
+    """Concrete count of valid entries in a padded row layout, rounded up to
+    ``multiple`` (host sync; preprocessing only)."""
+    nnz = int(jnp.sum(jval > 0))
+    return max(multiple, (nnz + multiple - 1) // multiple * multiple)
+
+
+def assemble_edges(jidx: jnp.ndarray, jval: jnp.ndarray, e_pad: int):
+    """Padded row layout [N, S] -> flat COO edge lists (src, dst, val), each
+    of static length ``e_pad`` (>= nnz; get it from :func:`edge_count`).
+
+    The row layout sizes EVERY row to the max symmetrized degree S — on
+    hub-heavy graphs (e.g. MNIST-60k, k=90: S = 3584 vs mean degree ~150)
+    the attraction sweep then does ~20x more gather/FLOP work than the
+    graph has edges.  The edge layout is sized by the TRUE edge count, stays
+    fully static, and reduces with a sorted ``segment_sum`` — the
+    TPU-friendly form of the reference's per-row sparse loop
+    (TsneHelpers.scala:290-302).  Padding edges carry (src=0, dst=0, val=0)
+    and contribute exactly zero force and loss.
+
+    ``src`` is ascending INCLUDING the padding tail (tail slots carry
+    src = n-1, dst = 0, val = 0), so consumers may pass
+    ``indices_are_sorted=True`` to ``segment_sum`` — the flag is a guarantee
+    to XLA, and a tail of zeros after ascending row ids would break it.
+    """
+    n, s = jidx.shape
+    flat_val = jval.reshape(-1)
+    flat_dst = jidx.reshape(-1).astype(jnp.int32)
+    flat_src = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], (n, s)).reshape(-1)
+    valid = flat_val > 0
+    pos = jnp.cumsum(valid) - 1          # destination slot of each valid entry
+    slot = jnp.where(valid, pos, e_pad)  # invalid -> dump slot
+    src = jnp.full((e_pad + 1,), n - 1, jnp.int32).at[slot].set(
+        flat_src, mode="drop")[:e_pad]
+    dst = jnp.zeros((e_pad + 1,), jnp.int32).at[slot].set(
+        flat_dst, mode="drop")[:e_pad]
+    val = jnp.zeros((e_pad + 1,), flat_val.dtype).at[slot].set(
+        jnp.where(valid, flat_val, 0.0), mode="drop")[:e_pad]
+    return src, dst, val
+
+
+def edges_beneficial(e_pad: int, n_rows: int, s: int) -> bool:
+    """THE auto-mode benefit gate: the edge layout wins when its (padded)
+    edge count is at most half the row layout's ``rows x S`` launched pairs.
+    Shared by :func:`plan_edges` (host paths, exact nnz) and the fused
+    ``SpmdPipeline`` gate (in-trace, which must size from the out+in upper
+    bound instead — the estimator differs by necessity, the threshold must
+    not)."""
+    return e_pad <= (n_rows * s) // 2
+
+
+def plan_edges(jidx: jnp.ndarray, jval: jnp.ndarray, mode: str = "auto",
+               multiple: int = 1024):
+    """THE attraction-layout decision, shared by every host-staged entry
+    point (``tsne_embed``, ``ShardedOptimizer``, ``bench.py``) so the policy
+    cannot drift between them (the fused ``SpmdPipeline`` shares
+    :func:`edges_beneficial` but sizes in-trace from the nnz upper bound).
+    For the row block ``(jidx, jval)`` returns ``(use_edges, e_pad)``:
+    ``use_edges`` is True when ``mode`` is ``"edges"``, or ``"auto"`` and
+    :func:`edges_beneficial` (hub-heavy graphs).  Host sync — preprocessing
+    only."""
+    if mode not in ("auto", "rows", "edges"):
+        raise ValueError(f"attraction mode '{mode}' not defined "
+                         "(auto | rows | edges)")
+    if mode == "rows":
+        return False, 0
+    n_rows, s = jidx.shape
+    e_pad = edge_count(jval, multiple)
+    return (mode == "edges" or edges_beneficial(e_pad, n_rows, s)), e_pad
+
+
 def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
                        sym_width: int | None = None,
                        return_dropped: bool = False,

@@ -78,32 +78,84 @@ class ShardedOptimizer:
         self.n_local = self.n_padded // d
         self._fns = {}  # num_iters (static) -> compiled segment runner
 
-    def _segment_fn(self, num_iters: int):
-        if num_iters in self._fns:
-            return self._fns[num_iters]
+    def _segment_fn(self, num_iters: int, with_edges: bool = False):
+        key = (num_iters, with_edges)
+        if key in self._fns:
+            return self._fns[key]
         cfg_ = self.cfg
         if self.n_devices == 1:
             fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters))
         else:
             n_local = self.n_local
 
-            def local_run(state, jidx, jval, valid, start_iter, loss_carry):
+            def local_run(state, jidx, jval, valid, start_iter, loss_carry,
+                          edges=None):
                 row_offset = lax.axis_index(AXIS) * n_local
                 return optimize(state, jidx, jval, cfg_, axis_name=AXIS,
                                 row_offset=row_offset, valid=valid,
                                 start_iter=start_iter, num_iters=num_iters,
-                                loss_carry=loss_carry)
+                                loss_carry=loss_carry, edges=edges)
 
             pspec = P(AXIS)
             state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
+            in_specs = [state_spec, pspec, pspec, pspec, P(), P()]
+            if with_edges:
+                in_specs.append((pspec, pspec, pspec))
             fn = jax.jit(
                 jax.shard_map(
                     local_run, mesh=self.mesh,
-                    in_specs=(state_spec, pspec, pspec, pspec, P(), P()),
+                    in_specs=tuple(in_specs),
                     out_specs=(state_spec, P()),  # loss trace psum-replicated
                 ))
-        self._fns[num_iters] = fn
+        self._fns[key] = fn
         return fn
+
+    def attraction_plan(self, jidx, jval):
+        """Which attraction layout this optimizer will launch for (UNPADDED
+        or padded) global rows, and how many pairs it launches — the hook the
+        bench's FLOP/MFU model uses so it can never drift from what actually
+        runs.  Returns ``(layout, launched_pairs, e_pad)`` with ``layout`` in
+        {"rows", "edges"} and ``e_pad`` the per-shard edge padding (0 for
+        rows)."""
+        from tsne_flink_tpu.ops.affinities import plan_edges
+        mode = getattr(self.cfg, "attraction", "auto")
+        if jidx.shape[0] != self.n_padded:  # mirror _pad_inputs
+            jidx = pad_rows(jidx, self.n_padded - jidx.shape[0])
+            jval = pad_rows(jval, self.n_padded - jval.shape[0])
+        s = jidx.shape[1]
+        if self.n_devices == 1:
+            use, e_pad = plan_edges(jidx, jval, mode)
+            return (("edges", e_pad, e_pad) if use
+                    else ("rows", self.n_padded * s, 0))
+        nl = self.n_local
+        plans = [plan_edges(jidx[d * nl:(d + 1) * nl],
+                            jval[d * nl:(d + 1) * nl], mode)
+                 for d in range(self.n_devices)]
+        e_local = max(e for _, e in plans)
+        # one static per-shard size: every shard must agree on the layout
+        use = (mode == "edges"
+               or (mode == "auto" and e_local <= (nl * s) // 2))
+        if use and mode != "rows":
+            return "edges", e_local * self.n_devices, e_local
+        return "rows", self.n_padded * s, 0
+
+    def _build_edges(self, jidx, jval):
+        """Host-side prep: padded rows -> per-shard flat COO edge arrays with
+        LOCAL row indices, equal length per shard (see
+        ops/affinities.assemble_edges).  Returns None when
+        :meth:`attraction_plan` picks the row layout."""
+        from tsne_flink_tpu.ops.affinities import assemble_edges
+        layout, _, e_pad = self.attraction_plan(jidx, jval)
+        if layout != "edges":
+            return None
+        if self.n_devices == 1:
+            return jax.jit(partial(assemble_edges, e_pad=e_pad))(jidx, jval)
+        nl = self.n_local
+        conv = jax.jit(partial(assemble_edges, e_pad=e_pad))
+        parts = [conv(jidx[d * nl:(d + 1) * nl], jval[d * nl:(d + 1) * nl])
+                 for d in range(self.n_devices)]
+        return tuple(jnp.concatenate([p[c] for p in parts])
+                     for c in range(3))
 
     def _pad_inputs(self, state: TsneState, jidx, jval):
         npad = self.n_padded - self.n
@@ -123,17 +175,29 @@ class ShardedOptimizer:
         return jnp.zeros((max(self.cfg.n_loss_slots, 1),), dtype)
 
     def lower(self, state, jidx, jval):
-        fn = self._segment_fn(self.cfg.iterations)
+        """AOT-lower the SAME program __call__ would run — including the
+        attraction layout, so an --executionPlan dump shows the real
+        attraction sweep, not unconditionally the rows one."""
         if self.n_devices == 1:
+            edges = self._build_edges(jidx, jval)
+            fn = self._segment_fn(self.cfg.iterations)
             return fn.lower(state, jidx, jval, start_iter=0,
-                            loss_carry=self._loss0(state.y.dtype))
+                            loss_carry=self._loss0(state.y.dtype),
+                            edges=edges)
         state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
-        return fn.lower(state, jidx, jval, valid, 0,
-                        self._loss0(state.y.dtype))
+        edges = self._build_edges(jidx, jval)
+        fn = self._segment_fn(self.cfg.iterations,
+                              with_edges=edges is not None)
+        args = (state, jidx, jval, valid, 0, self._loss0(state.y.dtype))
+        return fn.lower(*args, edges) if edges is not None else fn.lower(*args)
 
-    def _run_segment(self, fn, state, jidx, jval, valid, start, losses):
+    def _run_segment(self, fn, state, jidx, jval, valid, start, losses,
+                     edges=None):
         if self.n_devices == 1:
-            return fn(state, jidx, jval, start_iter=start, loss_carry=losses)
+            return fn(state, jidx, jval, start_iter=start, loss_carry=losses,
+                      edges=edges)
+        if edges is not None:
+            return fn(state, jidx, jval, valid, start, losses, edges)
         return fn(state, jidx, jval, valid, start, losses)
 
     def __call__(self, state: TsneState, jidx, jval, *, start_iter: int = 0,
@@ -163,6 +227,18 @@ class ShardedOptimizer:
                 losses = losses[:want]
         else:
             losses = self._loss0(state.y.dtype)
+        # multi-controller callers hold non-addressable global arrays that the
+        # host cannot slice — the edge conversion is a single-controller prep
+        if pre_padded_valid is not None:
+            edges = None
+            if getattr(self.cfg, "attraction", "auto") == "edges":
+                import sys
+                print("WARNING: attraction='edges' is not available in "
+                      "multi-controller runs (host cannot slice "
+                      "non-addressable rows); running the rows layout",
+                      file=sys.stderr)
+        else:
+            edges = self._build_edges(jidx, jval)
         total = self.cfg.iterations
         seg = (checkpoint_every if checkpoint_every
                and checkpoint_cb is not None else total - start_iter)
@@ -171,9 +247,9 @@ class ShardedOptimizer:
             step = min(seg, total - it)
             if step <= 0:
                 break
-            fn = self._segment_fn(step)
+            fn = self._segment_fn(step, with_edges=edges is not None)
             state, losses = self._run_segment(fn, state, jidx, jval, valid,
-                                              it, losses)
+                                              it, losses, edges)
             it += step
             if checkpoint_cb is not None and it < total:
                 checkpoint_cb(self._unpad(state) if unpad else state,

@@ -94,6 +94,7 @@ class SpmdPipeline:
         self.sym_width = (int(sym_width) if sym_width is not None
                           else max(8, (2 * self.k + 7) // 8 * 8))
         self._escalations = 0
+        self._edge_pad = None  # static per-shard edge count after escalation
         self._compiled = None
         self._prepared = None
         self._runner = None
@@ -136,6 +137,27 @@ class SpmdPipeline:
         # padding rows must contribute no affinity mass
         dist = jnp.where(valid[:, None], dist, jnp.inf)
         p_cond = pairwise_affinities(dist, cfg.perplexity, axis_name=AXIS)
+
+        # upper bound on this shard's symmetrized edge count, measured BEFORE
+        # symmetrization so row truncation cannot undercount it: every merged
+        # (i, j) entry of row i comes from a forward edge (out) or a transpose
+        # edge (in), so out + in >= distinct entries.  Used to size the flat
+        # edge layout (_maybe_escalate/_local_fn).  Skipped (constant 0) when
+        # the edge layout can never engage — pinned-width auto runs and
+        # attraction="rows" — so those pay no extra [n_padded] psum.
+        mode = getattr(self.cfg, "attraction", "auto")
+        if mode == "edges" or (mode == "auto" and not self._sym_width_pinned):
+            present = (p_cond > 0) & valid[:, None]
+            in_counts = jax.ops.segment_sum(
+                present.reshape(-1).astype(jnp.int32),
+                idx.reshape(-1), num_segments=self.n_padded)
+            in_counts = lax.psum(in_counts, AXIS)
+            in_local = lax.dynamic_slice_in_dim(in_counts, row_offset,
+                                                self.n_local)
+            nnz_ub = jnp.sum(present.astype(jnp.int32)) + jnp.sum(in_local)
+            nnz = lax.pmax(nnz_ub, AXIS)
+        else:
+            nnz = jnp.zeros((), jnp.int32)
 
         if self.sym_mode == "alltoall":
             # scalable: transpose edges ROUTED to their owner shard over ICI
@@ -183,7 +205,7 @@ class SpmdPipeline:
         y = lax.dynamic_slice_in_dim(y_full, row_offset, self.n_local)
         state = TsneState(y=y, update=jnp.zeros_like(y),
                           gains=jnp.ones_like(y))
-        return jidx, jval, state, dropped, needed
+        return jidx, jval, state, dropped, needed, nnz
 
     def _check_dropped(self, dropped):
         """Host-side strict check: with ``sym_strict`` a run whose P was
@@ -202,16 +224,33 @@ class SpmdPipeline:
 
     def _local_fn(self, *args):
         *data, valid, key_data, start_iter, loss_carry = args
-        jidx, jval, state, dropped, needed = self._prepare_local(
+        jidx, jval, state, dropped, needed, nnz = self._prepare_local(
             *data, valid, key_data)
         me = lax.axis_index(AXIS)
+
+        # the fused program cannot size a flat edge layout on its FIRST
+        # attempt (nnz is data-dependent, shapes must be static) — but an
+        # auto-width overflow forces a recompile anyway, and _maybe_escalate
+        # records the measured per-shard edge bound; the recompiled program
+        # then runs the attraction sweep over true edges instead of
+        # N x max-hub-degree padded rows (ops/affinities.assemble_edges).
+        # attraction="edges" sizes the pad up-front via a prep pass
+        # (__call__) and bypasses the auto benefit gate.
+        edges = None
+        mode = getattr(self.cfg, "attraction", "auto")
+        if self._edge_pad is not None and mode != "rows":
+            from tsne_flink_tpu.ops.affinities import (assemble_edges,
+                                                       edges_beneficial)
+            if mode == "edges" or edges_beneficial(
+                    self._edge_pad, self.n_local, self.sym_width):
+                edges = assemble_edges(jidx, jval, self._edge_pad)
 
         def run_opt(_):
             st, losses = optimize(state, jidx, jval, self.cfg,
                                   axis_name=AXIS,
                                   row_offset=me * self.n_local, valid=valid,
                                   start_iter=start_iter,
-                                  loss_carry=loss_carry)
+                                  loss_carry=loss_carry, edges=edges)
             return st.y, losses
 
         if self._sym_width_pinned or self._escalations >= 2:
@@ -223,7 +262,7 @@ class SpmdPipeline:
             y, losses = lax.cond(dropped[1] > 0,
                                  lambda _: (state.y, loss_carry),
                                  run_opt, None)
-        return y, losses, dropped, needed
+        return y, losses, dropped, needed, nnz
 
     def _fn(self):
         if self._compiled is None:
@@ -231,14 +270,29 @@ class SpmdPipeline:
             self._compiled = jax.jit(jax.shard_map(
                 self._local_fn, mesh=self.mesh,
                 in_specs=(pspec,) * self._n_data + (pspec, P(), P(), P()),
-                out_specs=(pspec, P(), P(), P())))
+                out_specs=(pspec, P(), P(), P(), P())))
         return self._compiled
 
-    def _maybe_escalate(self, dropped, needed) -> bool:
+    def _maybe_escalate(self, dropped, needed, nnz=None) -> bool:
         """True iff rows overflowed an AUTO width: adopt the measured true
         width, drop the compiled programs, and let the caller rerun.  Bounded
         to 2 escalations (the measured width is deterministic for a given
-        (x, key), so one is normally enough; the bound is a safety net)."""
+        (x, key), so one is normally enough; the bound is a safety net).
+        The measured per-shard edge count rides along so the recompiled fused
+        program can use the flat edge layout for attraction (_local_fn)."""
+        # stale-pad refresh: a pipeline reused on a DENSER graph of the same
+        # shapes must never run assemble_edges with a pad below the measured
+        # bound (undersized pads silently drop edges) — recompile and rerun
+        if (self._edge_pad is not None and nnz is not None
+                and int(np.asarray(nnz)) > self._edge_pad):
+            e = int(np.asarray(nnz))
+            import sys
+            print(f"# edge pad {self._edge_pad} below measured bound {e}; "
+                  "resizing and rerunning", file=sys.stderr)
+            self._edge_pad = max(8, (e + 7) // 8 * 8)
+            self._compiled = None
+            self._prepared = None
+            return True
         if self._sym_width_pinned or self._escalations >= 2:
             return False
         if int(np.asarray(dropped)[1]) == 0:
@@ -248,6 +302,9 @@ class SpmdPipeline:
         print(f"# sym_width {self.sym_width} overflowed; escalating to {new} "
               "and rerunning", file=sys.stderr)
         self.sym_width = new
+        if nnz is not None:
+            e = int(np.asarray(nnz))
+            self._edge_pad = max(8, (e + 7) // 8 * 8)
         self._escalations += 1
         self._compiled = None
         self._prepared = None
@@ -295,7 +352,25 @@ class SpmdPipeline:
     def _key_data(key):
         return jnp.asarray(jax.random.key_data(key))
 
+    def _size_edge_pad(self, x, key):
+        """One prep-only pass measuring the per-shard edge bound, so an
+        explicitly requested edge layout can be compiled with static shapes
+        (attraction="edges"; auto mode instead rides the width-escalation
+        recompile and never pays this extra pass)."""
+        self._build_prepared()
+        *xp, valid = self._pad(x)
+        nnz = self._prepared(*xp, valid, self._key_data(key))[-1]
+        e = int(np.asarray(nnz))
+        self._edge_pad = max(8, (e + 7) // 8 * 8)
+
     def lower(self, x, key):
+        """AOT-lower the program the NEXT __call__ attempt would compile
+        (for attraction="edges" that includes sizing the edge layout first;
+        auto-mode lowering shows the first attempt, whose layout a width
+        escalation may later upgrade)."""
+        if (getattr(self.cfg, "attraction", "auto") == "edges"
+                and self._edge_pad is None):
+            self._size_edge_pad(x, key)
         *xp, valid = self._pad(x)
         return self._fn().lower(*xp, valid, self._key_data(key), jnp.int32(0),
                                 self._loss0(xp[-1].dtype))
@@ -310,7 +385,7 @@ class SpmdPipeline:
             self._prepared = jax.jit(jax.shard_map(
                 self._prepare_local, mesh=self.mesh,
                 in_specs=(pspec,) * self._n_data + (pspec, P()),
-                out_specs=(pspec, pspec, state_spec, P(), P())))
+                out_specs=(pspec, pspec, state_spec, P(), P(), P())))
         return self._prepared
 
     def prepare(self, x, key):
@@ -320,9 +395,9 @@ class SpmdPipeline:
         while True:
             self._build_prepared()
             *xp, valid = self._pad(x)
-            jidx, jval, state, dropped, needed = self._prepared(
+            jidx, jval, state, dropped, needed, nnz = self._prepared(
                 *xp, valid, self._key_data(key))
-            if not self._maybe_escalate(dropped, needed):
+            if not self._maybe_escalate(dropped, needed, nnz):
                 break
         self._check_dropped(dropped)
         n = self.n
@@ -379,11 +454,11 @@ class SpmdPipeline:
         while True:
             self._build_prepared()
             *xp, valid = self._pad(x)
-            jidx, jval, state, dropped, needed = self._prepared(
+            jidx, jval, state, dropped, needed, nnz = self._prepared(
                 *xp, valid, self._key_data(key))
             # replicated counters: host-readable on every process, and every
             # process computes the same ints -> consistent recompile
-            if not self._maybe_escalate(dropped, needed):
+            if not self._maybe_escalate(dropped, needed, nnz):
                 break
         self._check_dropped(dropped)
 
@@ -418,12 +493,15 @@ class SpmdPipeline:
         (host-side slicing of a non-addressable array is impossible); fetch
         with ``jax.experimental.multihost_utils.process_allgather`` and slice
         to ``pipe.n``, as the CLI does."""
+        if (getattr(self.cfg, "attraction", "auto") == "edges"
+                and self._edge_pad is None):
+            self._size_edge_pad(x, key)
         while True:
             *xp, valid = self._pad(x)
-            y, losses, dropped, needed = self._fn()(
+            y, losses, dropped, needed, nnz = self._fn()(
                 *xp, valid, self._key_data(key), jnp.int32(0),
                 self._loss0(xp[-1].dtype))
-            if not self._maybe_escalate(dropped, needed):
+            if not self._maybe_escalate(dropped, needed, nnz):
                 break
         self._check_dropped(dropped)  # dropped is replicated: every process
         if jax.process_count() > 1:

@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repulsion", default="auto",
                    choices=["auto", "exact", "bh", "fft"],
                    help="auto: exact when theta==0 or N small, else bh/fft")
+    p.add_argument("--attraction", default="auto",
+                   choices=["auto", "rows", "edges"],
+                   help="attraction layout: padded [N,S] rows or the flat "
+                        "edge list sized by the true edge count (auto: edges "
+                        "when hub rows make S >= 2x the mean degree)")
     p.add_argument("--bhGate", default="vdm", choices=["vdm", "flink"],
                    help="BH acceptance test: vdm = side/sqrt(D) < theta "
                         "(scale-free, accurate); flink = the reference's "
@@ -288,6 +293,7 @@ def main(argv=None) -> int:
         metric=args.metric,
         repulsion=pick_repulsion(args.repulsion, args.theta, n,
                                  args.nComponents, theta_explicit),
+        attraction=args.attraction,
         bh_gate=args.bhGate,
     )
 

@@ -120,11 +120,15 @@ def affinity_flops(n: int, k: int, steps: int = 50) -> float:
     return search + sym
 
 
-def attraction_flops_per_iter(n: int, s: int, m: int) -> float:
+def attraction_flops_per_iter(n: int, s: int, m: int,
+                              nnz_pairs: float | None = None) -> float:
     """F_attr (models/tsne.py:_attractive_forces): per (i,j) pair — sqdist
     (3m), Student-t kernel (~2), P*q weight + row sums (~3), force
-    accumulation (2m), loss term (~4) => ~5m+9 ops over n*s pairs."""
-    return n * s * (5.0 * m + 9.0)
+    accumulation (2m), loss term (~4) => ~5m+9 ops over the launched pairs:
+    n*s for the padded row layout, or the (padded) true edge count when the
+    edge layout runs (models/tsne.py:_attractive_forces_edges)."""
+    pairs = float(n) * s if nnz_pairs is None else float(nnz_pairs)
+    return pairs * (5.0 * m + 9.0)
 
 
 def repulsion_flops_per_iter(n: int, m: int, backend: str, *,
@@ -169,10 +173,11 @@ def repulsion_flops_per_iter(n: int, m: int, backend: str, *,
 
 
 def optimize_flops(n: int, s: int, m: int, iters: int, backend: str,
-                   **rep_kwargs) -> float:
+                   nnz_pairs: float | None = None, **rep_kwargs) -> float:
     """Full optimizer loop: per iteration, attraction + repulsion + the
-    gains/momentum update (~10 ops per coordinate) + centering (~3)."""
-    per_iter = (attraction_flops_per_iter(n, s, m)
+    gains/momentum update (~10 ops per coordinate) + centering (~3).
+    ``nnz_pairs``: launched attraction pairs when the edge layout runs."""
+    per_iter = (attraction_flops_per_iter(n, s, m, nnz_pairs)
                 + repulsion_flops_per_iter(n, m, backend, **rep_kwargs)
                 + n * m * 13.0)
     return iters * per_iter
