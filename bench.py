@@ -154,7 +154,7 @@ def main():
     k = 90  # 3 * perplexity (Tsne.scala:55)
     # the same auto recall policy the CLI runs: Z-order seed + NN-descent
     rounds = pick_knn_rounds(n)
-    refine = pick_knn_refine(n)
+    refine = pick_knn_refine(n, int(x_np.shape[1]))
 
     x = jnp.asarray(x_np)
     t0 = time.time()

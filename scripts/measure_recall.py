@@ -61,7 +61,7 @@ def main():
     # band coverage (band = block + 2k)
     from tsne_flink_tpu.ops.knn import (knn as knn_dispatch,
                                         pick_knn_refine, pick_knn_rounds)
-    auto = (pick_knn_rounds(n), pick_knn_refine(n))
+    auto = (pick_knn_rounds(n), pick_knn_refine(n, d))
     # (zorder_seed_rounds, hybrid_cycles) plans; cycles=0 rows show why the
     # hybrid policy exists (banded Z-order rounds saturate at large N)
     plans = ([(3, 0), (6, 0), (12, 0), (3, 1), (3, 2), (3, 3), (3, 4),

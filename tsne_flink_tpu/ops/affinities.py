@@ -211,6 +211,14 @@ def assemble_edges(jidx: jnp.ndarray, jval: jnp.ndarray, e_pad: int):
     to XLA, and a tail of zeros after ascending row ids would break it.
     """
     n, s = jidx.shape
+    if n * s >= 2 ** 31:
+        # the slot cumsum below runs in int32 (int64 silently demotes to
+        # int32 without jax_enable_x64) and would wrap, silently corrupting
+        # the scatter — shard the rows or use the rows layout instead
+        # (plan_edges auto-declines at this size)
+        raise ValueError(
+            f"edge conversion needs {n} x {s} = {n * s} int32 cumsum slots "
+            ">= 2^31; shard the point axis or use attraction='rows'")
     flat_val = jval.reshape(-1)
     flat_dst = jidx.reshape(-1).astype(jnp.int32)
     flat_src = jnp.broadcast_to(
@@ -253,6 +261,9 @@ def plan_edges(jidx: jnp.ndarray, jval: jnp.ndarray, mode: str = "auto",
     if mode == "rows":
         return False, 0
     n_rows, s = jidx.shape
+    if mode == "auto" and n_rows * s >= 2 ** 31:
+        return False, 0  # conversion would overflow int32 slots (see
+        # assemble_edges); auto declines, explicit "edges" raises there
     e_pad = edge_count(jval, multiple)
     return (mode == "edges" or edges_beneficial(e_pad, n_rows, s)), e_pad
 

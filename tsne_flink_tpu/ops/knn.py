@@ -62,16 +62,35 @@ def pick_knn_rounds(n: int) -> int:
     return 3  # band covers small N; hybrid cycles carry recall at large N
 
 
-def pick_knn_refine(n: int) -> int:
+def pick_knn_filter(d: int) -> int | None:
+    """Auto filtered-rerank width for the hybrid refine's local join: rank
+    candidates in a ``filter_dims``-wide random projection and exact-rerank
+    only the best ``filter_keep x k`` (see :func:`knn_refine`).  Only worth
+    it when the full width dwarfs the projection (the filter adds its own
+    gather + top_k); below that the single-stage exact rerank is cheaper."""
+    return 32 if d > 128 else None
+
+
+def pick_knn_refine(n: int, d: int | None = None) -> int:
     """Auto hybrid refine cycles (each = 2 fresh Z-order rounds + 1
     NN-descent round) after the seed: none needed while the band covers a
     large fraction of N (plain Z-order rounds are cheaper there — see
-    :func:`pick_knn_rounds`); grows gently with N beyond that (measured
-    operating points: scripts/measure_recall.py, README table — 20k x 784:
-    0.98@2, 0.99@3; 60k x 784: 0.95@4)."""
+    :func:`pick_knn_rounds`); grows gently with N beyond that.  When the
+    filtered rerank is active (``d`` given and :func:`pick_knn_filter`
+    engages) one extra cycle compensates the filter's per-cycle recall cost
+    at large N — measured at 60k x 784, k=90 (scripts/measure_recall.py):
+    unfiltered 0.947@4 cycles in 728s; filtered 0.886@4 in 292s,
+    0.924@5 in 363s (the policy point: >0.9 at half the unfiltered cost);
+    filtered keep=8 0.918@4 in 423s loses to +1 cycle on both axes.
+    The 8k-32k mid band needs no bump: measured at 20k x 784 the filtered
+    default holds 0.973@3 cycles in 79.5s vs unfiltered 0.99@3 in ~200s
+    (results/recall_20k_filtered.txt)."""
     if n <= 8000:
         return 0
-    return max(2, min(5, math.ceil(math.log2(n / 4000))))
+    cycles = max(2, min(5, math.ceil(math.log2(n / 4000))))
+    if d is not None and n > 32000 and pick_knn_filter(d) is not None:
+        cycles = min(cycles + 1, 6)
+    return cycles
 
 
 def knn_bruteforce(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
@@ -212,7 +231,8 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
                key: jax.Array | None = None,
                x_full: jnp.ndarray | None = None,
                idx_full: jnp.ndarray | None = None,
-               row_offset: int = 0, n_valid: int | None = None):
+               row_offset: int = 0, n_valid: int | None = None,
+               filter_dims: int | None = None, filter_keep: int = 5):
     """Neighbor-of-neighbor refinement of an approximate kNN graph — the
     TPU-regular form of NN-descent's local join (Dong et al., public
     algorithm): pure sorts, gathers and fixed-shape distance tiles, no hash
@@ -245,6 +265,20 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     all-gathered global arrays (``parallel/knn.project_knn_sharded``), and
     the reverse sample is built from the global graph.  ``n_valid`` masks
     candidates at or beyond it (mesh padding rows must never be proposed).
+
+    ``filter_dims``: two-stage re-rank.  The local join's cost is dominated
+    by gathering full ``dim``-wide vectors for all 2s(1+k) candidates per
+    row (at 60k x 784, k=90: ~1456 gathers of 784 floats per row per round —
+    pure HBM traffic, no MXU).  With ``filter_dims`` set, candidates are
+    first ranked by squared distance in a per-round random Gaussian
+    projection of that width (JL: euclidean ranks are approximately
+    preserved; for the cosine metric the projection is taken of the
+    L2-normalized points so angles map to euclidean), and only the best
+    ``filter_keep x k`` survivors get the exact full-width re-rank — an
+    ~(C/keep) x (dim/filter_dims-amortized) cut in gather bytes.  Distances
+    that land in the graph stay EXACT either way; filtering can only affect
+    which candidates are considered (recall measured in
+    scripts/measure_recall.py).
     """
     nloc, k = idx.shape
     xf = x if x_full is None else x_full
@@ -258,13 +292,30 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     if key is None:
         key = jax.random.key(7)
 
+    dim = xf.shape[1]
+    keep = min(filter_keep * k, 2 * s * (1 + k))
+    do_filter = (filter_dims is not None and 0 < filter_dims < dim
+                 and keep < 2 * s * (1 + k))
+    if do_filter and metric == "cosine":
+        norm = jnp.linalg.norm(xf, axis=1, keepdims=True)
+        fbase = xf / jnp.maximum(norm, 1e-12)
+    else:
+        fbase = xf
+
     for rnd in range(max(0, rounds)):
         # out-gateways for the LOCAL rows only (the expansion below reads
         # u only at this shard's rows — building gateways for all N would
         # replicate an [N, k] sort per device per cycle): nearest s/2 always
         # + random rest, re-drawn per round (fixed-shape exploration: random
         # scores, nearest slots forced to -inf so a bottom-s pick keeps them)
-        key, gkey, vkey = jax.random.split(key, 3)
+        key, gkey, vkey, fkey = jax.random.split(key, 4)
+        if do_filter:
+            # fresh projection per round: filter errors decorrelate across
+            # rounds, so a candidate unluckily filtered out this round gets
+            # re-proposed and re-judged under a different projection later
+            r = jax.random.normal(fkey, (dim, filter_dims), xf.dtype
+                                  ) / jnp.sqrt(jnp.asarray(dim, xf.dtype))
+            proj = fbase @ r                               # [N, fd]
         gidx_loc = gidx[rows_g]                       # [nloc, k]
         if s < k:
             score = jax.random.uniform(gkey, gidx_loc.shape)
@@ -291,8 +342,17 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
             mine = u_loc[rc - row_offset]        # [c, 2s]
             cand = jnp.concatenate(
                 [mine, gidx[mine].reshape(c, -1)], axis=1)  # [c, 2s(1+k)]
+            if do_filter:
+                pr = proj[rc]                    # [c, fd]
+                pc = proj[cand]                  # [c, C, fd]
+                ad = jnp.sum((pr[:, None, :] - pc) ** 2, axis=-1)
+                ad = jnp.where(cand == rc[:, None], jnp.inf, ad)
+                if n_valid is not None:
+                    ad = jnp.where(cand >= n_valid, jnp.inf, ad)
+                _, sel = lax.top_k(-ad, keep)
+                cand = jnp.take_along_axis(cand, sel, axis=1)  # [c, keep]
             xr = xf[rc]                          # [c, dim]
-            xc = xf[cand]                        # [c, C, dim]
+            xc = xf[cand]                        # [c, C|keep, dim]
             dd = f(xr[:, None, :], xc)
             dd = jnp.where(cand == rc[:, None], jnp.inf, dd)
             if n_valid is not None:
@@ -431,7 +491,8 @@ ZORDER_PER_CYCLE = 2
 
 def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                         seed_rounds: int = 3, cycles: int = 2,
-                        key: jax.Array | None = None):
+                        key: jax.Array | None = None,
+                        filter_dims: int | str | None = "auto"):
     """The hybrid high-recall plan: a Z-order seed graph, then ``cycles`` of
     (2 fresh Z-order rounds merged in + 1 NN-descent refine round).
 
@@ -443,6 +504,8 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
     via :func:`merge_rounds`."""
     if key is None:
         key = jax.random.key(0)
+    if filter_dims == "auto":
+        filter_dims = pick_knn_filter(x.shape[1])
     key, skey = jax.random.split(key)
     idx, dist = knn_project(x, k, metric, seed_rounds, skey)
     for cyc in range(max(0, cycles)):
@@ -451,7 +514,8 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                              start_round=seed_rounds
                              + cyc * ZORDER_PER_CYCLE)
         idx, dist = merge_rounds([dist, dz], [idx, iz], k)
-        idx, dist = knn_refine(x, idx, dist, metric, rounds=1, key=rkey)
+        idx, dist = knn_refine(x, idx, dist, metric, rounds=1, key=rkey,
+                               filter_dims=filter_dims)
     return idx, dist
 
 
@@ -469,7 +533,7 @@ def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
         if rounds is None:
             rounds = pick_knn_rounds(x.shape[0])
         if refine is None:
-            refine = pick_knn_refine(x.shape[0])
+            refine = pick_knn_refine(x.shape[0], x.shape[1])
         if refine > 0:
             return knn_project_refined(x, k, metric, rounds, refine, key)
         return knn_project(x, k, metric, rounds, key)

@@ -123,19 +123,28 @@ class ShardedOptimizer:
             jidx = pad_rows(jidx, self.n_padded - jidx.shape[0])
             jval = pad_rows(jval, self.n_padded - jval.shape[0])
         s = jidx.shape[1]
+        if mode == "rows":
+            # must short-circuit BEFORE the per-shard plans: plan_edges
+            # reports e_pad=0 for "rows", which the benefit gate below would
+            # misread as "zero edges — beneficial"
+            return "rows", self.n_padded * s, 0
         if self.n_devices == 1:
             use, e_pad = plan_edges(jidx, jval, mode)
             return (("edges", e_pad, e_pad) if use
                     else ("rows", self.n_padded * s, 0))
+        from tsne_flink_tpu.ops.affinities import edges_beneficial
         nl = self.n_local
+        if mode == "auto" and nl * s >= 2 ** 31:
+            # per-shard conversion would overflow int32 slots: every shard's
+            # plan_edges declines with e_pad=0, which must not read as
+            # "zero edges, beneficial" below
+            return "rows", self.n_padded * s, 0
         plans = [plan_edges(jidx[d * nl:(d + 1) * nl],
                             jval[d * nl:(d + 1) * nl], mode)
                  for d in range(self.n_devices)]
         e_local = max(e for _, e in plans)
         # one static per-shard size: every shard must agree on the layout
-        use = (mode == "edges"
-               or (mode == "auto" and e_local <= (nl * s) // 2))
-        if use and mode != "rows":
+        if mode == "edges" or edges_beneficial(e_local, nl, s):
             return "edges", e_local * self.n_devices, e_local
         return "rows", self.n_padded * s, 0
 

@@ -75,7 +75,7 @@ class SpmdPipeline:
         self.knn_rounds = (knn_rounds if knn_rounds is not None
                            else pick_knn_rounds(n))
         self.knn_refine = (knn_refine if knn_refine is not None
-                           else pick_knn_refine(n))
+                           else pick_knn_refine(n, dim))
         self.sym_mode = sym_mode
         self.sym_slack = sym_slack
         self.mesh = make_mesh(n_devices)

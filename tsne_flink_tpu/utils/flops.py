@@ -81,10 +81,14 @@ def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
       the d=784 matmuls — and excluded.
     * hybrid refinement (knn_project_refined): each of the ``refine_rounds``
       cycles adds ZORDER_PER_CYCLE more Z-order rounds plus one NN-descent
-      round — per refine round each row exact-ranks 2s·(1 + k) local-join
+      round — per refine round each row ranks 2s·(1 + k) local-join
       candidates (the full k out-lists of its fwd∪rev sample neighborhood)
       at ~3d ops per pair (elementwise distance, no shared-column matmul),
       plus the edge-list sort for the reverse sample (~2*n*k*log2(2nk) ops).
+      With the auto filtered rerank active (pick_knn_filter: d > 128), the
+      candidate ranking instead costs a 2*n*d*fd projection + ~3*fd ops per
+      candidate + ~3*d ops for only the filter_keep*k exact survivors
+      (ops/knn.knn_refine filter_dims).
     """
     if method in ("bruteforce", "partition"):
         return distance_tile_flops(n, n, d)
@@ -98,12 +102,19 @@ def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
         zrounds = rounds
         total = 0.0
         if refine_rounds > 0:
-            from tsne_flink_tpu.ops.knn import ZORDER_PER_CYCLE
+            from tsne_flink_tpu.ops.knn import (ZORDER_PER_CYCLE,
+                                                pick_knn_filter)
             zrounds += refine_rounds * ZORDER_PER_CYCLE
             s = min(refine_sample, k)
             cand = 2 * s * (1 + k)
-            per_ref = (n * cand * 3.0 * d
-                       + 2.0 * n * k * math.log2(max(2 * n * k, 2)))
+            fd = pick_knn_filter(d)  # mirror the auto two-stage policy
+            if fd:
+                keep = min(5 * k, cand)
+                rank = (2.0 * n * d * fd + n * cand * 3.0 * fd
+                        + n * keep * 3.0 * d)
+            else:
+                rank = n * cand * 3.0 * d
+            per_ref = rank + 2.0 * n * k * math.log2(max(2 * n * k, 2))
             total += refine_rounds * per_ref
         total += zrounds * per_round
         return total
