@@ -130,6 +130,14 @@ def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 300
     repulsion = sys.argv[3] if len(sys.argv) > 3 else "auto"
+    attraction = sys.argv[4] if len(sys.argv) > 4 else "auto"
+    if attraction not in ("auto", "rows", "edges"):
+        # fail in under a second, not after the ~6-min kNN stage
+        raise SystemExit(f"attraction arg '{attraction}' not defined "
+                         "(auto | rows | edges)")
+    if repulsion not in ("auto", "exact", "bh", "fft"):
+        raise SystemExit(f"repulsion arg '{repulsion}' not defined "
+                         "(auto | exact | bh | fft)")
     # defaulted CLI theta (Tsne.scala:59 / cli.py); 0.5 only for an explicit
     # bh run — that is BASELINE config 2 verbatim (its theta IS the BH knob)
     theta = 0.5 if repulsion == "bh" else 0.25
@@ -150,7 +158,8 @@ def main():
         mosaic_supported()
 
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=theta,
-                     repulsion=repulsion, row_chunk=4096)
+                     repulsion=repulsion, attraction=attraction,
+                     row_chunk=4096)
     k = 90  # 3 * perplexity (Tsne.scala:55)
     # the same auto recall policy the CLI runs: Z-order seed + NN-descent
     rounds = pick_knn_rounds(n)

@@ -17,6 +17,10 @@ step() {
 
 # 1. headline bench (fft default) — the round's deliverable
 STEP_TIMEOUT=1800 step bench_60k_fft python bench.py 60000 300 fft
+# 1b. on-chip A/B of the round-3 optimizations (the auto policy runs
+# edge-layout attraction + filtered rerank; this pins the rows-layout
+# counterfactual on hardware — CPU A/B committed in README round 3)
+STEP_TIMEOUT=1800 step bench_60k_fft_rows python bench.py 60000 300 fft rows
 # 2. pallas-exact on hardware (Mosaic lowering proof) at bench scale
 STEP_TIMEOUT=1800 step bench_60k_exact python bench.py 60000 300 exact
 # 3. BH backend at bench scale
