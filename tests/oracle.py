@@ -86,15 +86,18 @@ def joint_dense(idx, p):
     return pm
 
 
-def gradient(pm, y, metric, exaggeration=1.0):
+def gradient(pm, y, exaggeration=1.0):
     """Exact (theta=0) gradient + KL loss: grad_i = sum_j P q (yi-yj) - rep_i/Z."""
     n, m = y.shape
     pe = pm * exaggeration
+    # the embedding-space kernel is ALWAYS squared-euclidean Student-t; the
+    # CLI metric applies to the high-dim affinity stage only (deliberate fix
+    # vs TsneHelpers.scala:293 — models/tsne._attractive_forces docstring)
     q_att = np.zeros((n, n))
     for i in range(n):
         for j in range(n):
             if i != j:
-                q_att[i, j] = 1.0 / (1.0 + dist(y[i], y[j], metric))
+                q_att[i, j] = 1.0 / (1.0 + dist(y[i], y[j], "sqeuclidean"))
     q_rep = np.zeros((n, n))
     for i in range(n):
         for j in range(n):
@@ -127,7 +130,7 @@ def update(y, upd, gains, grad, momentum, lr, min_gain=0.01):
     return y, upd, gains
 
 
-def run(pm, y0, iterations, metric="sqeuclidean", lr=1000.0,
+def run(pm, y0, iterations, lr=1000.0,
         early_exaggeration=4.0, m0=0.5, m1=0.8):
     """Full 3-phase optimization; returns (y, {iter_1based: loss})."""
     y = y0.copy()
@@ -139,7 +142,7 @@ def run(pm, y0, iterations, metric="sqeuclidean", lr=1000.0,
     for i in range(iterations):
         momentum = m0 if i < p1 else m1
         exag = early_exaggeration if i < pe_end else 1.0
-        grad, loss = gradient(pm, y, metric, exag)
+        grad, loss = gradient(pm, y, exag)
         if (i + 1) % 10 == 0:
             losses[i + 1] = loss
         y, upd, gains = update(y, upd, gains, grad, momentum, lr)

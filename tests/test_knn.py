@@ -87,6 +87,25 @@ def test_project_recall_and_exact_distances():
     assert recall > 0.5, f"project-kNN recall too low: {recall:.3f}"
 
 
+def test_project_cosine_zorders_normalized_points():
+    """Cosine-metric project kNN must Z-order the L2-normalized points:
+    on data whose radii span decades, euclidean curve locality scatters
+    equal-direction points and recall collapses (measured 0.835 raw vs
+    0.900 normalized at 3k; this small pin uses a sharper contrast)."""
+    import jax
+    rng = np.random.default_rng(5)
+    n, d, k = 600, 32, 8
+    dirs = rng.standard_normal((n, d)).astype(np.float32)
+    radii = np.exp(rng.uniform(-3, 3, (n, 1))).astype(np.float32)
+    x = jnp.asarray(dirs * radii)
+    _, dist_exact = knn_bruteforce(x, k, "cosine")
+    _, dist_approx = knn_project(x, k, "cosine", rounds=4,
+                                 key=jax.random.key(1))
+    kth = np.asarray(dist_exact)[:, -1][:, None] * (1 + 1e-5) + 1e-5
+    recall = float((np.asarray(dist_approx) <= kth).mean())
+    assert recall >= 0.85, f"cosine project recall {recall:.3f}"
+
+
 def test_project_low_dim_no_projection_path():
     x = blobs(80, 2, seed=4)
     import jax

@@ -44,6 +44,7 @@ from tsne_flink_tpu.ops.repulsion_fft import fft_repulsion
 from tsne_flink_tpu.ops.repulsion_pallas import pallas_exact_repulsion
 
 LOSS_EVERY = 10  # TsneHelpers.scala:297
+REPULSION_BACKENDS = ("exact", "bh", "fft")  # _gradient dispatch / CLI / bench
 
 
 @dataclass(frozen=True)
@@ -109,15 +110,25 @@ def _psum(x, axis_name):
     return x if axis_name is None else lax.psum(x, axis_name)
 
 
-def _attractive_forces(y_local, y_full, jidx, jval, metric, exag, z,
+def _attractive_forces(y_local, y_full, jidx, jval, exag, z,
                        row_chunk=4096):
-    """F_attr_i = Σ_j P_ij q_ij (y_i − y_j), q via the CLI metric
-    (TsneHelpers.scala:284-305), plus the partial KL loss Σ p log(p/(q/Z))
-    (:297-300).  Row-chunked so the [c, S, m] gather stays in VMEM-friendly
-    tiles."""
+    """F_attr_i = Σ_j P_ij q_ij (y_i − y_j) with the Student-t kernel
+    q = 1/(1 + ‖y_i − y_j‖²) (TsneHelpers.scala:284-305), plus the partial
+    KL loss Σ p log(p/(q/Z)) (:297-300).  Row-chunked so the [c, S, m]
+    gather stays in VMEM-friendly tiles.
+
+    DELIBERATE fix vs the reference: the embedding-space kernel is ALWAYS
+    squared-euclidean Student-t — the low-dim similarity t-SNE is defined
+    on — while ``--metric`` applies to the high-dim kNN/affinity stage
+    only.  The reference reuses the input metric here
+    (TsneHelpers.scala:293) but its repulsion stays euclidean
+    (QuadTree.scala:133-141); with ``--metric cosine`` that q does not
+    decay with radius, the force balance breaks, and the embedding
+    diverges to overflow (reproduced: 120-point blobs, NaN by iteration
+    ~40)."""
     nloc, m = y_local.shape
     s = jidx.shape[1]
-    f = metric_fn(metric)
+    f = metric_fn("sqeuclidean")
     c = min(row_chunk, nloc)
     nchunks = math.ceil(nloc / c)
     pad = nchunks * c - nloc
@@ -144,14 +155,15 @@ def _attractive_forces(y_local, y_full, jidx, jval, metric, exag, z,
     return att.reshape(-1, m)[:nloc], jnp.sum(loss)
 
 
-def _attractive_forces_edges(y_local, y_full, src, dst, val, metric, exag, z):
+def _attractive_forces_edges(y_local, y_full, src, dst, val, exag, z):
     """Edge-layout attraction: identical math to :func:`_attractive_forces`
+    (including the always-sqeuclidean Student-t kernel — see its docstring)
     but summed per-edge with a sorted ``segment_sum`` instead of per padded
     row slot — work scales with the TRUE edge count, not N x max hub degree
     (see :func:`tsne_flink_tpu.ops.affinities.assemble_edges`).  ``src`` holds
     LOCAL row indices of this shard; ``dst`` indexes the gathered global
     embedding."""
-    f = metric_fn(metric)
+    f = metric_fn("sqeuclidean")
     yi = y_local[src]                     # [E, m]
     yj = y_full[dst]                      # [E, m]
     q = 1.0 / (1.0 + f(yi, yj))           # [E]
@@ -208,9 +220,9 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
     z = _psum(sq, axis_name)
     if edges is not None:
         att, loss = _attractive_forces_edges(y_local, y_full, *edges,
-                                             cfg.metric, exag, z)
+                                             exag, z)
     else:
-        att, loss = _attractive_forces(y_local, y_full, jidx, jval, cfg.metric,
+        att, loss = _attractive_forces(y_local, y_full, jidx, jval,
                                        exag, z, row_chunk=cfg.row_chunk)
     loss = _psum(loss, axis_name)
     return att - rep / z, loss

@@ -33,6 +33,7 @@ MAX_BISECT_STEPS = 50
 H_TOL = 1e-5
 ZERO_SUM_GUARD = 1e-7
 P_FLOOR = 1e-12  # the intended clamp at TsneHelpers.scala:191,194
+ATTRACTION_MODES = ("auto", "rows", "edges")  # plan_edges / CLI / bench
 
 
 def _row_entropy(d, valid, beta, dtype):
@@ -255,9 +256,9 @@ def plan_edges(jidx: jnp.ndarray, jval: jnp.ndarray, mode: str = "auto",
     ``use_edges`` is True when ``mode`` is ``"edges"``, or ``"auto"`` and
     :func:`edges_beneficial` (hub-heavy graphs).  Host sync — preprocessing
     only."""
-    if mode not in ("auto", "rows", "edges"):
+    if mode not in ATTRACTION_MODES:
         raise ValueError(f"attraction mode '{mode}' not defined "
-                         "(auto | rows | edges)")
+                         f"({' | '.join(ATTRACTION_MODES)})")
     if mode == "rows":
         return False, 0
     n_rows, s = jidx.shape

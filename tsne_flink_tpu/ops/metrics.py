@@ -5,9 +5,11 @@ Parity with the reference's metric dispatch (``Tsne.scala:161-168``), which maps
 ``euclideanDistance`` and ``cosineDistance``.  Two forms are provided:
 
 * :func:`metric_fn` — an elementwise pair metric ``(..., d), (..., d) -> (...)``,
-  used for the attractive-force q_ij in embedding space (the reference applies the
-  *same* CLI metric there, ``TsneHelpers.scala:293``) and for exact re-ranking of
-  approximate kNN candidates.
+  used for exact re-ranking of approximate kNN candidates, and (always with
+  ``"sqeuclidean"``) for the embedding-space Student-t q_ij.  The CLI metric
+  deliberately does NOT reach embedding space: the reference applies it there
+  (``TsneHelpers.scala:293``) while its repulsion stays euclidean, which makes
+  its cosine mode diverge (``models/tsne._attractive_forces`` docstring).
 * :func:`pairwise` — a blocked distance *matrix* ``[Na, d] x [Nb, d] -> [Na, Nb]``
   formulated around a single matmul so XLA tiles it onto the MXU
   (``‖a‖² + ‖b‖² − 2 a·bᵀ``), replacing the reference's per-record Breeze calls

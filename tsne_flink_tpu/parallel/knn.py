@@ -168,6 +168,15 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
 
     valid_col = (gids < n_global)[:, None]
 
+    # cosine metric: Z-order the L2-normalized points so curve locality
+    # tracks angles, not euclidean position (ops/knn.knn_project, same fix)
+    if metric == "cosine":
+        zbase = x_full / jnp.maximum(
+            jnp.linalg.norm(x_full, axis=1, keepdims=True),
+            jnp.asarray(1e-12, dtype))
+    else:
+        zbase = x_full
+
     def round_perm(it, rkey):
         """Replicated (identical on every device) Z-order permutation of the
         padded global point set; padding rows sort last."""
@@ -175,9 +184,9 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
             pkey, _ = jax.random.split(rkey)
             r = jax.random.normal(pkey, (dim, m), dtype) / jnp.sqrt(
                 jnp.asarray(dim, dtype))
-            z = x_full @ r
+            z = zbase @ r
         else:
-            z = x_full
+            z = zbase
         # masked min-max quantize (padding rows excluded from the range);
         # the shift of TsneHelpers.scala:97-99 is equivalent to shifting the
         # quantization GRID, so it is folded into `lo` directly

@@ -64,11 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--knnBlocks", type=int, default=None,
                    help="default: number of devices (Tsne.scala:63)")
     # --- TPU-native extensions ---
+    from tsne_flink_tpu.models.tsne import REPULSION_BACKENDS
+    from tsne_flink_tpu.ops.affinities import ATTRACTION_MODES
     p.add_argument("--repulsion", default="auto",
-                   choices=["auto", "exact", "bh", "fft"],
+                   choices=["auto", *REPULSION_BACKENDS],
                    help="auto: exact when theta==0 or N small, else bh/fft")
     p.add_argument("--attraction", default="auto",
-                   choices=["auto", "rows", "edges"],
+                   choices=list(ATTRACTION_MODES),
                    help="attraction layout: padded [N,S] rows or the flat "
                         "edge list sized by the true edge count (auto: edges "
                         "when hub rows make S >= 2x the mean degree)")
