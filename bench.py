@@ -131,15 +131,15 @@ def main():
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 300
     repulsion = sys.argv[3] if len(sys.argv) > 3 else "auto"
     attraction = sys.argv[4] if len(sys.argv) > 4 else "auto"
-    from tsne_flink_tpu.models.tsne import REPULSION_BACKENDS
+    from tsne_flink_tpu.models.tsne import REPULSION_CHOICES
     from tsne_flink_tpu.ops.affinities import ATTRACTION_MODES
     if attraction not in ATTRACTION_MODES:
         # fail in under a second, not after the ~6-min kNN stage
         raise SystemExit(f"attraction arg '{attraction}' not defined "
                          f"({' | '.join(ATTRACTION_MODES)})")
-    if repulsion not in ("auto",) + REPULSION_BACKENDS:
+    if repulsion not in REPULSION_CHOICES:
         raise SystemExit(f"repulsion arg '{repulsion}' not defined "
-                         f"(auto | {' | '.join(REPULSION_BACKENDS)})")
+                         f"({' | '.join(REPULSION_CHOICES)})")
     # defaulted CLI theta (Tsne.scala:59 / cli.py); 0.5 only for an explicit
     # bh run — that is BASELINE config 2 verbatim (its theta IS the BH knob)
     theta = 0.5 if repulsion == "bh" else 0.25

@@ -28,8 +28,19 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # 8-device virtual mesh BEFORE jax initializes (tests/conftest.py pattern)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# XLA's CPU in-process collectives CHECK-fail the whole job when a
+# participant thread misses the rendezvous by ~40s — on an oversubscribed
+# host (8 virtual devices sharing 1 core at N=1M) a device can legitimately
+# spend minutes of wall-clock reaching a big all_gather.  Raise the stuck
+# heuristics; these are liveness warnings, not correctness (two 1M attempts
+# died to exactly this CHECK, results/large_n_1m.log history).
+for _f, _v in (("xla_cpu_collective_call_warn_stuck_timeout_seconds", 600),
+               ("xla_cpu_collective_call_terminate_timeout_seconds", 10800),
+               ("xla_cpu_collective_timeout_seconds", 10800)):
+    if _f not in _flags:  # never override a user-set value
+        _flags += f" --{_f}={_v}"
+os.environ["XLA_FLAGS"] = _flags
 
 import jax
 

@@ -33,7 +33,7 @@ class TSNE:
                  knn_blocks: int = 8, knn_iterations: int | None = None,
                  knn_refine: int | None = None, random_state: int = 0,
                  spmd: bool = False, devices: int | None = None,
-                 sym_mode: str = "replicated"):
+                 sym_mode: str = "replicated", attraction: str = "auto"):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -59,6 +59,18 @@ class TSNE:
         self.spmd = spmd
         self.devices = devices
         self.sym_mode = sym_mode
+        # attraction-sweep layout — see ops/affinities.plan_edges; auto picks
+        # the flat edge layout on hub-heavy graphs.  Validated HERE so a typo
+        # fails at construction, not after the multi-minute kNN stage
+        from tsne_flink_tpu.models.tsne import REPULSION_CHOICES
+        from tsne_flink_tpu.ops.affinities import ATTRACTION_MODES
+        if attraction not in ATTRACTION_MODES:
+            raise ValueError(f"attraction '{attraction}' not defined "
+                             f"({' | '.join(ATTRACTION_MODES)})")
+        if repulsion not in REPULSION_CHOICES:
+            raise ValueError(f"repulsion '{repulsion}' not defined "
+                             f"({' | '.join(REPULSION_CHOICES)})")
+        self.attraction = attraction
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
@@ -75,7 +87,8 @@ class TSNE:
             metric=self.metric,
             repulsion=pick_repulsion(self.repulsion, self.theta, n,
                                      self.n_components,
-                                     self.theta_explicit_))
+                                     self.theta_explicit_),
+            attraction=self.attraction)
 
     def fit(self, x, y=None) -> "TSNE":
         import jax

@@ -44,7 +44,8 @@ from tsne_flink_tpu.ops.repulsion_fft import fft_repulsion
 from tsne_flink_tpu.ops.repulsion_pallas import pallas_exact_repulsion
 
 LOSS_EVERY = 10  # TsneHelpers.scala:297
-REPULSION_BACKENDS = ("exact", "bh", "fft")  # _gradient dispatch / CLI / bench
+REPULSION_BACKENDS = ("exact", "bh", "fft")  # _gradient dispatch
+REPULSION_CHOICES = ("auto",) + REPULSION_BACKENDS  # CLI / bench / api
 
 
 @dataclass(frozen=True)

@@ -168,14 +168,10 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
 
     valid_col = (gids < n_global)[:, None]
 
-    # cosine metric: Z-order the L2-normalized points so curve locality
-    # tracks angles, not euclidean position (ops/knn.knn_project, same fix)
-    if metric == "cosine":
-        zbase = x_full / jnp.maximum(
-            jnp.linalg.norm(x_full, axis=1, keepdims=True),
-            jnp.asarray(1e-12, dtype))
-    else:
-        zbase = x_full
+    # cosine metric: Z-order the L2-normalized points (shared helper so the
+    # sharded and single-device bases can never drift)
+    from tsne_flink_tpu.ops.knn import cosine_zbase
+    zbase = cosine_zbase(x_full) if metric == "cosine" else x_full
 
     def round_perm(it, rkey):
         """Replicated (identical on every device) Z-order permutation of the

@@ -64,10 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--knnBlocks", type=int, default=None,
                    help="default: number of devices (Tsne.scala:63)")
     # --- TPU-native extensions ---
-    from tsne_flink_tpu.models.tsne import REPULSION_BACKENDS
+    from tsne_flink_tpu.models.tsne import REPULSION_CHOICES
     from tsne_flink_tpu.ops.affinities import ATTRACTION_MODES
     p.add_argument("--repulsion", default="auto",
-                   choices=["auto", *REPULSION_BACKENDS],
+                   choices=list(REPULSION_CHOICES),
                    help="auto: exact when theta==0 or N small, else bh/fft")
     p.add_argument("--attraction", default="auto",
                    choices=list(ATTRACTION_MODES),
