@@ -209,6 +209,7 @@ def main():
     f_aff = affinity_flops(n, k)
     f_opt = optimize_flops(n, s, 2, iters, repulsion,
                            nnz_pairs=pairs if use_edges else None,
+                           theta=cfg.theta,  # bh auto-frontier mirror
                            mpad=8 if backend == "tpu" else 3)
     flops = f_knn + f_aff + f_opt
     kind = jax.devices()[0].device_kind if backend == "tpu" else ""

@@ -26,36 +26,63 @@ def make_coo(path, n, d, seed=0):
     centers = rng.random((10, d)).astype(np.float32)
     x = centers[rng.integers(0, 10, n)] + 0.1 * rng.standard_normal(
         (n, d)).astype(np.float32)
-    with open(path, "w") as f:
-        for i in range(n):
-            row = x[i]
-            f.write("\n".join(f"{i},{j},{float(row[j])!r}"
-                              for j in range(d)) + "\n")
+    # vectorized writer: the full-size configs emit up to 47M COO lines
+    ii = np.repeat(np.arange(n), d).astype(np.float64)
+    jj = np.tile(np.arange(d), n).astype(np.float64)
+    np.savetxt(path, np.stack([ii, jj, x.reshape(-1).astype(np.float64)],
+                              axis=1), fmt="%d,%d,%.8g")
     return x
 
 
 def make_knn_coo(path, n, d, k, seed=0):
-    """Precomputed-kNN distance matrix in COO (i, j, dist) — config 4."""
+    """Precomputed-kNN distance matrix in COO (i, j, dist) — config 4.
+
+    Uses the framework's own memory-scalable exact kNN (column-block
+    streaming top-k) so the generator reaches the config's true 400k points
+    — a dense [n, n] numpy matrix would need 640 GB there."""
     rng = np.random.default_rng(seed)
     x = rng.standard_normal((n, d)).astype(np.float32)
-    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
-    np.fill_diagonal(d2, np.inf)
-    idx = np.argsort(d2, axis=1)[:, :k]
-    with open(path, "w") as f:
-        for i in range(n):
-            f.write("\n".join(
-                f"{i},{int(j)},{float(d2[i, j])!r}" for j in idx[i]) + "\n")
+    import jax
+    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from tsne_flink_tpu.ops.knn import knn_partition
+    blocks = max(8, n // 8192)
+    idx, dist = jax.jit(lambda a: knn_partition(a, k, blocks=blocks))(
+        jnp.asarray(x))
+    idx, dist = np.asarray(idx), np.asarray(dist)
+    rows = np.repeat(np.arange(n), k)
+    arr = np.stack([rows.astype(np.float64), idx.reshape(-1).astype(
+        np.float64), dist.reshape(-1).astype(np.float64)], axis=1)
+    np.savetxt(path, arr, fmt="%d,%d,%.9g", delimiter=",")
+
+
+_RSS_SHIM = ("import resource, subprocess, sys; "
+             "r = subprocess.run(sys.argv[1:]); "
+             "print('PEAK_RSS_KB=%d' % resource.getrusage("
+             "resource.RUSAGE_CHILDREN).ru_maxrss); sys.exit(r.returncode)")
 
 
 def cli(args, env=None):
-    cmd = [sys.executable, "-m", "tsne_flink_tpu.utils.cli"] + args
+    """Run the CLI in a child; returns (seconds, last stdout line,
+    peak_rss_bytes) — the RSS shim reports the child's high-water mark."""
+    cmd = [sys.executable, "-c", _RSS_SHIM,
+           sys.executable, "-m", "tsne_flink_tpu.utils.cli"] + args
     t0 = time.time()
     r = subprocess.run(cmd, env=env, capture_output=True, text=True)
     dt = time.time() - t0
     if r.returncode != 0:
         print(r.stdout[-1500:], r.stderr[-1500:])
         raise SystemExit(f"FAILED: {' '.join(args)}")
-    return dt, r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    lines = r.stdout.strip().splitlines()
+    rss = 0
+    out = ""
+    for ln in lines:
+        if ln.startswith("PEAK_RSS_KB="):
+            rss = int(ln.split("=")[1]) * 1024
+        else:
+            out = ln
+    return dt, out, rss
 
 
 def main():
@@ -86,70 +113,87 @@ def main():
     # config's true 2,500 points — ADVICE r1 flagged a stray 10x multiplier)
     n1 = max(200, int(2500 * s))
     make_coo(p("c1.csv"), n1, 784 if s >= 1 else 32)
-    dt, out = cli(["--input", p("c1.csv"), "--output", p("c1_out.csv"),
-                   "--dimension", "784" if s >= 1 else "32",
-                   "--knnMethod", "bruteforce", "--iterations",
-                   "1000" if s >= 1 else "100", "--perplexity", "30"
-                   if s >= 1 else "10"], env)
-    results.append(("config1 bruteforce 2.5k-class", n1, dt, out))
+    dt, out, rss = cli(["--input", p("c1.csv"), "--output", p("c1_out.csv"),
+                        "--dimension", "784" if s >= 1 else "32",
+                        "--knnMethod", "bruteforce", "--iterations",
+                        "1000" if s >= 1 else "100", "--perplexity", "30"
+                        if s >= 1 else "10"], env)
+    results.append(("config1 bruteforce 2.5k-class", n1, dt, out, rss))
 
     # config 2: MNIST-60k, project kNN, theta=0.5 BH, perplexity 30
     n2 = max(400, int(60000 * s))
     make_coo(p("c2.csv"), n2, 784 if s >= 1 else 32, seed=1)
-    dt, out = cli(["--input", p("c2.csv"), "--output", p("c2_out.csv"),
-                   "--dimension", "784" if s >= 1 else "32",
-                   "--knnMethod", "project", "--theta", "0.5",
-                   "--repulsion", "bh",
-                   "--perplexity", "30" if s >= 1 else "8",
-                   "--iterations", "300" if s >= 1 else "60"], env)
-    results.append(("config2 project+BH 60k-class", n2, dt, out))
+    dt, out, rss = cli(["--input", p("c2.csv"), "--output", p("c2_out.csv"),
+                        "--dimension", "784" if s >= 1 else "32",
+                        "--knnMethod", "project", "--theta", "0.5",
+                        "--repulsion", "bh",
+                        "--perplexity", "30" if s >= 1 else "8",
+                        "--iterations", "300" if s >= 1 else "60"], env)
+    results.append(("config2 project+BH 60k-class", n2, dt, out, rss))
 
     # config 3: Fashion-70k, cosine, nComponents=3, earlyExaggeration=12
     n3 = max(400, int(70000 * s))
     make_coo(p("c3.csv"), n3, 784 if s >= 1 else 32, seed=2)
-    dt, out = cli(["--input", p("c3.csv"), "--output", p("c3_out.csv"),
-                   "--dimension", "784" if s >= 1 else "32",
-                   "--knnMethod", "project", "--metric", "cosine",
-                   "--nComponents", "3", "--earlyExaggeration", "12",
-                   "--perplexity", "30" if s >= 1 else "8",
-                   "--iterations", "300" if s >= 1 else "60"], env)
+    dt, out, rss = cli(["--input", p("c3.csv"), "--output", p("c3_out.csv"),
+                        "--dimension", "784" if s >= 1 else "32",
+                        "--knnMethod", "project", "--metric", "cosine",
+                        "--nComponents", "3", "--earlyExaggeration", "12",
+                        "--perplexity", "30" if s >= 1 else "8",
+                        "--iterations", "300" if s >= 1 else "60"], env)
     y3 = np.loadtxt(p("c3_out.csv"), delimiter=",")
     assert y3.shape[1] == 4, "id + 3 components"
-    results.append(("config3 cosine 3-D 70k-class", n3, dt, out))
+    results.append(("config3 cosine 3-D 70k-class", n3, dt, out, rss))
 
-    # config 4: precomputed-kNN distance matrix input (GloVe-400k-class)
-    n4 = max(300, int(400000 * s * 0.2))
-    make_knn_coo(p("c4.csv"), n4, 16, 12, seed=3)
-    dt, out = cli(["--input", p("c4.csv"), "--output", p("c4_out.csv"),
-                   "--dimension", "100", "--knnMethod", "bruteforce",
-                   "--inputDistanceMatrix", "--neighbors", "12",
-                   "--perplexity", "4", "--iterations", "60"], env)
-    results.append(("config4 distance-matrix 400k-class", n4, dt, out))
+    # config 4: precomputed-kNN distance matrix input (GloVe-400k).  At
+    # scale 1 this is the config's true 400k x 100d with a k=90 graph
+    # (perplexity 30, the GloVe run's shape); smoke scales shrink all three.
+    n4 = max(300, int(400000 * s))
+    d4, k4 = (100, 90) if s >= 1 else (16, 12)
+    px4 = "30" if s >= 1 else "4"
+    make_knn_coo(p("c4.csv"), n4, d4, k4, seed=3)
+    dt, out, rss = cli(["--input", p("c4.csv"), "--output", p("c4_out.csv"),
+                        "--dimension", str(d4), "--knnMethod", "bruteforce",
+                        "--inputDistanceMatrix", "--neighbors", str(k4),
+                        "--perplexity", px4, "--iterations",
+                        "300" if s >= 1 else "60"], env)
+    results.append(("config4 distance-matrix 400k-class", n4, dt, out, rss))
 
     # config 4b (round 3): the same precomputed graph through the SPMD
     # pipeline — the reference's distance-matrix input runs distributed
     # (Tsne.scala:70,155-159), and since round 3 so does ours
-    dt, out = cli(["--input", p("c4.csv"), "--output", p("c4b_out.csv"),
-                   "--dimension", "100", "--knnMethod", "bruteforce",
-                   "--inputDistanceMatrix", "--neighbors", "12",
-                   "--perplexity", "4", "--iterations", "60", "--spmd"], env)
-    results.append(("config4b distance-matrix --spmd", n4, dt, out))
+    dt, out, rss = cli(["--input", p("c4.csv"), "--output", p("c4b_out.csv"),
+                        "--dimension", str(d4), "--knnMethod", "bruteforce",
+                        "--inputDistanceMatrix", "--neighbors", str(k4),
+                        "--perplexity", px4, "--iterations", "60", "--spmd"],
+                       env)
+    results.append(("config4b distance-matrix --spmd", n4, dt, out, rss))
 
     # config 5: 1.3M multi-host analog — full SPMD pipeline (single process
     # here; tests/test_multiprocess.py covers the true 2-process run)
     n5 = max(500, int(1_300_000 * s * 0.01))
     make_coo(p("c5.csv"), n5, 32, seed=4)
-    dt, out = cli(["--input", p("c5.csv"), "--output", p("c5_out.csv"),
-                   "--dimension", "32", "--knnMethod", "project",
-                   "--perplexity", "50" if s >= 1 else "8",
-                   "--iterations", "60", "--spmd", "--symMode", "alltoall"],
-                  env)
-    results.append(("config5 spmd 1.3M-class", n5, dt, out))
+    dt, out, rss = cli(["--input", p("c5.csv"), "--output", p("c5_out.csv"),
+                        "--dimension", "32", "--knnMethod", "project",
+                        "--perplexity", "50" if s >= 1 else "8",
+                        "--iterations", "60", "--spmd", "--symMode",
+                        "alltoall"], env)
+    results.append(("config5 spmd 1.3M-class", n5, dt, out, rss))
 
     print(f"\nall {len(results)} BASELINE configs ran end-to-end "
           f"(scale={s}):")
-    for name, n, dt, out in results:
-        print(f"  {name:36s} n={n:<7d} {dt:6.1f}s  | {out}")
+    for name, n, dt, out, rss in results:
+        print(f"  {name:36s} n={n:<7d} {dt:6.1f}s  "
+              f"rss={rss/2**30:5.1f}GB | {out}")
+    # per-config JSONs for the judge (VERDICT r3 next-step #6)
+    import json
+    os.makedirs("results", exist_ok=True)
+    for name, n, dt, out, rss in results:
+        tag = name.split()[0]
+        with open(os.path.join(
+                "results", f"baseline_{tag}_scale{s:g}.json"), "w") as f:
+            json.dump({"config": name, "n": n, "scale": s,
+                       "wall_seconds": round(dt, 1),
+                       "peak_rss_bytes": rss, "last_line": out}, f)
 
 
 if __name__ == "__main__":

@@ -10,10 +10,15 @@ independent yardstick.  Compares, on the same blobs dataset:
 
 Usage: python scripts/validate_quality.py [n] [dim] [repulsion] [knn_method]
        python scripts/validate_quality.py --digits [repulsion]
+       ... [--dtype bfloat16]
 
 --digits runs on sklearn's bundled handwritten-digits set (1797 x 64) — a
 REAL no-egress dataset with manifold structure, complementing the synthetic
 blobs (VERDICT r2 next-step #7).
+
+--dtype runs OUR optimizer in that dtype (the CLI's --dtype; bfloat16 is the
+MXU-native 2x path) while sklearn stays f64 — the KL/trustworthiness deltas
+vs our f32 row are the bf16 quality evidence (VERDICT r3 next-step #7).
 """
 
 import os
@@ -34,6 +39,12 @@ jax.config.update("jax_platforms",
 
 
 def main():
+    dtype = None
+    argv = list(sys.argv)
+    if "--dtype" in argv:
+        i = argv.index("--dtype")
+        dtype = argv[i + 1]
+        del sys.argv[i:i + 2]
     if "--digits" in sys.argv:
         from sklearn.datasets import load_digits
         x = load_digits().data.astype(np.float32)
@@ -66,14 +77,15 @@ def main():
 
     t0 = time.time()
     ours = TSNE(perplexity=30.0, n_iter=1000, repulsion=repulsion,
-                knn_method=knn_method, random_state=0)
-    y_us = ours.fit_transform(x)
+                knn_method=knn_method, random_state=0, dtype=dtype)
+    y_us = ours.fit_transform(x).astype(np.float64)
     t_us = time.time() - t0
 
     tw_sk = trustworthiness(x, y_sk, n_neighbors=12)
     tw_us = trustworthiness(x, y_us, n_neighbors=12)
 
-    print(f"{label} repulsion={repulsion} knn={knn_method}")
+    print(f"{label} repulsion={repulsion} knn={knn_method}"
+          + (f" dtype={dtype}" if dtype else ""))
     print(f"sklearn : KL={sk.kl_divergence_:.4f}  trustworthiness={tw_sk:.4f}"
           f"  ({t_sk:.1f}s)")
     print(f"ours    : KL={ours.kl_divergence_:.4f}  "

@@ -152,13 +152,14 @@ def test_pick_knn_plan_heuristic():
     assert pick_knn_rounds(60000) == 3
     assert pick_knn_refine(60000) == 4
     assert pick_knn_refine(10**7) == 5   # capped
-    # filtered-rerank compensation: +1 cycle when the two-stage rerank is
-    # active (d > 128) at n > 32k — measured 0.924@5 cycles vs 0.886@4 at
-    # 60k x 784 (pick_knn_refine docstring)
-    assert pick_knn_refine(60000, 784) == 5
+    # staged-funnel compensation: +2 cycles when the cascade funnel is
+    # active (d > 128) at n > 32k — r4 frontier: 0.932@6 cycles/382s vs
+    # the single-stage funnel's 0.923@5/376s at 60k x 784
+    # (pick_knn_refine docstring, results/recall_60k_r4.txt)
+    assert pick_knn_refine(60000, 784) == 6
     assert pick_knn_refine(60000, 64) == 4   # filter off at small d
     assert pick_knn_refine(20000, 784) == 3  # no bump below 32k
-    assert pick_knn_refine(10**7, 784) == 6
+    assert pick_knn_refine(10**7, 784) == 7
 
 
 def test_reverse_sample():

@@ -195,10 +195,15 @@ def test_symmetrize_alltoall_matches_replicated():
     fn = jax.jit(jax.shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(), P())))
-    jidx_g, jval_g, dropped, needed = fn(idx, p)
+        out_specs=(P(AXIS), P(AXIS), P(), P(), P())))
+    jidx_g, jval_g, dropped, needed, nnz = fn(idx, p)
     assert int(dropped.sum()) == 0  # [capacity, width] counters both clean
     assert int(needed) <= s  # reported true width consistent with no drops
+    # the reported per-shard edge count is the max over shards of the TRUE
+    # distinct-entry count (exact layout sizing, ADVICE r3)
+    deg = (np.asarray(jval_ref) > 0).sum(axis=1)
+    want_nnz = max(deg[i * 6:(i + 1) * 6].sum() for i in range(8))
+    assert int(nnz) == want_nnz, (int(nnz), want_nnz)
     np.testing.assert_array_equal(np.asarray(jidx_g), np.asarray(jidx_ref))
     np.testing.assert_allclose(np.asarray(jval_g), np.asarray(jval_ref),
                                rtol=1e-12)
@@ -234,8 +239,8 @@ def test_symmetrize_alltoall_reports_capacity_drops():
     fn = jax.jit(jax.shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s, slack=1),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(), P())))
-    jidx_g, jval_g, dropped, _needed = fn(idx, p)
+        out_specs=(P(AXIS), P(AXIS), P(), P(), P())))
+    jidx_g, jval_g, dropped, _needed, _nnz = fn(idx, p)
     assert int(dropped[0]) > 0  # the tight cap must actually drop (and count)
     total = float(jnp.sum(jval_g))
     assert np.isfinite(np.asarray(jval_g)).all()
@@ -256,8 +261,8 @@ def test_symmetrize_alltoall_counts_width_overflow():
     fn = jax.jit(jax.shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(), P())))
-    jidx_g, jval_g, dropped, needed = fn(idx, p)
+        out_specs=(P(AXIS), P(AXIS), P(), P(), P())))
+    jidx_g, jval_g, dropped, needed, _nnz = fn(idx, p)
     assert int(dropped[1]) > 0
     assert int(needed) > s  # reports the width a retry needs
     # kept entries still renormalize exactly
@@ -352,3 +357,50 @@ def test_spmd_pipeline_sym_strict_passes_when_clean():
                         sym_strict=True, n_devices=8)(
         jnp.asarray(x), jax.random.key(11))
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_alltoall_capacity_auto_escalates_and_heals():
+    """A hub graph whose transpose edges all route to shard 0 overflows the
+    all_to_all capacity cap at the starting slack; the AUTO slack must
+    double-and-rerun (mirroring the width contract — VERDICT r3 weak #3)
+    until no edge drops, leaving P exactly symmetric."""
+    n, k = 48, 7
+    rng = np.random.default_rng(3)
+    idx = np.tile(np.arange(k, dtype=np.int32), (n, 1))
+    for i in range(k):  # no self-loops
+        idx[i, i] = k
+    dist = np.sort(rng.uniform(0.5, 2.0, (n, k)), axis=1)
+    cfg = TsneConfig(iterations=2, repulsion="exact", row_chunk=8,
+                     perplexity=3.0)
+    pipe = SpmdPipeline(cfg, n, 4, k, knn_method="precomputed",
+                        sym_mode="alltoall")
+    jidx, jval, _state = pipe.prepare(
+        (jnp.asarray(idx), jnp.asarray(dist)), jax.random.key(0))
+    # the overflow must actually have fired and self-healed
+    assert pipe._slack_escalations >= 1
+    assert pipe.sym_slack > 4
+    ji, jv = np.asarray(jidx), np.asarray(jval)
+    Pm = np.zeros((n, n))
+    rows = np.repeat(np.arange(n), ji.shape[1])
+    np.add.at(Pm, (rows, ji.reshape(-1)),
+              jv.reshape(-1) * (jv.reshape(-1) > 0))
+    # exact symmetry: a capacity-dropped transpose edge would leave its
+    # forward twin behind and break this bit-for-bit equality
+    np.testing.assert_array_equal(Pm, Pm.T)
+    np.testing.assert_allclose(Pm.sum(), 1.0, rtol=1e-12)
+
+
+def test_alltoall_pinned_slack_does_not_escalate():
+    """An explicitly pinned --symSlack keeps the old warn-only contract."""
+    n, k = 48, 7
+    rng = np.random.default_rng(3)
+    idx = np.tile(np.arange(k, dtype=np.int32), (n, 1))
+    for i in range(k):
+        idx[i, i] = k
+    dist = np.sort(rng.uniform(0.5, 2.0, (n, k)), axis=1)
+    cfg = TsneConfig(iterations=2, repulsion="exact", row_chunk=8,
+                     perplexity=3.0)
+    pipe = SpmdPipeline(cfg, n, 4, k, knn_method="precomputed",
+                        sym_mode="alltoall", sym_slack=1)
+    pipe.prepare((jnp.asarray(idx), jnp.asarray(dist)), jax.random.key(0))
+    assert pipe.sym_slack == 1 and pipe._slack_escalations == 0

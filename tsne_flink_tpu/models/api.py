@@ -33,7 +33,8 @@ class TSNE:
                  knn_blocks: int = 8, knn_iterations: int | None = None,
                  knn_refine: int | None = None, random_state: int = 0,
                  spmd: bool = False, devices: int | None = None,
-                 sym_mode: str = "replicated", attraction: str = "auto"):
+                 sym_mode: str = "replicated", attraction: str = "auto",
+                 dtype: str | None = None):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -71,6 +72,9 @@ class TSNE:
             raise ValueError(f"repulsion '{repulsion}' not defined "
                              f"({' | '.join(REPULSION_CHOICES)})")
         self.attraction = attraction
+        # compute dtype for the whole pipeline (the CLI's --dtype): None
+        # keeps the input's dtype; "bfloat16" is the MXU-native 2x path
+        self.dtype = dtype
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
@@ -94,7 +98,8 @@ class TSNE:
         import jax
         import jax.numpy as jnp
 
-        x = jnp.asarray(x)
+        x = (jnp.asarray(x) if self.dtype is None
+             else jnp.asarray(x, jnp.dtype(self.dtype)))
         cfg = self._config(x.shape[0])
         if self.spmd:
             from tsne_flink_tpu.parallel.pipeline import SpmdPipeline

