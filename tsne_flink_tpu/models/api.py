@@ -98,8 +98,29 @@ class TSNE:
         import jax
         import jax.numpy as jnp
 
-        x = (jnp.asarray(x) if self.dtype is None
-             else jnp.asarray(x, jnp.dtype(self.dtype)))
+        if self.dtype is not None and jnp.dtype(self.dtype) == jnp.bfloat16:
+            # mixed precision (the CLI's --dtype bfloat16 contract): bf16
+            # matmul operands, f32 state/accumulation — see
+            # ops/metrics.set_matmul_dtype.  The setting is trace-time
+            # process state; _fit restores it so one estimator cannot leak
+            # bf16 matmuls into later runs in the same process.
+            from tsne_flink_tpu.ops.metrics import (matmul_dtype,
+                                                    set_matmul_dtype)
+            prev = matmul_dtype()
+            set_matmul_dtype(jnp.bfloat16)
+            try:
+                return self._fit(jnp.asarray(x, jnp.float32))
+            finally:
+                set_matmul_dtype(prev)
+        elif self.dtype is not None:
+            x = jnp.asarray(x, jnp.dtype(self.dtype))
+        else:
+            x = jnp.asarray(x)
+        return self._fit(x)
+
+    def _fit(self, x) -> "TSNE":
+        import jax
+
         cfg = self._config(x.shape[0])
         if self.spmd:
             from tsne_flink_tpu.parallel.pipeline import SpmdPipeline

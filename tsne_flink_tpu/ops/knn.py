@@ -270,7 +270,10 @@ def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
     if jax.default_backend() == "cpu":
         d = pr[:, None, :] - pc
         return jnp.sum(d * d, axis=-1)
-    g = jnp.einsum("cf,czf->cz", pr, pc)
+    from tsne_flink_tpu.ops.metrics import acc_dtype, matmul_operands
+    prm, pcm = matmul_operands(pr, pc)
+    g = jnp.einsum("cf,czf->cz", prm, pcm,
+                   preferred_element_type=acc_dtype(pr))
     return jnp.maximum(sq[rows][:, None] + sq[cand] - 2.0 * g, 0.0)
 
 
@@ -283,7 +286,10 @@ def _cand_exact(metric: str, xf: jnp.ndarray, cache: jnp.ndarray,
     ``cache`` holds squared norms (sqeuclidean/euclidean) or norms
     (cosine)."""
     if metric == "cosine" and jax.default_backend() != "cpu":
-        g = jnp.einsum("cf,czf->cz", xf[rows], xf[cand])
+        from tsne_flink_tpu.ops.metrics import acc_dtype, matmul_operands
+        am, bm = matmul_operands(xf[rows], xf[cand])
+        g = jnp.einsum("cf,czf->cz", am, bm,
+                       preferred_element_type=acc_dtype(xf))
         return 1.0 - g / (cache[rows][:, None] * cache[cand])
     if metric == "cosine":
         from tsne_flink_tpu.ops.metrics import metric_fn

@@ -81,9 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "float64", "bfloat16"],
                    help="float32 (default, accuracy reference), float64 "
-                        "(CPU golden runs), or bfloat16 — the MXU-native "
-                        "dtype: ~2x matmul throughput, 8-bit mantissa; "
-                        "embedding geometry holds, the KL trace is coarse")
+                        "(CPU golden runs), or bfloat16 — MIXED precision: "
+                        "bf16 distance-matmul operands (the MXU's 2x rate), "
+                        "f32 state/accumulations/affinities.  (An all-bf16 "
+                        "pipeline is measurably fatal — 8-bit mantissa "
+                        "breaks the beta bisection; results/quality_bf16)")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size over the point axis (default: all)")
     p.add_argument("--symWidth", type=int, default=None,
@@ -205,6 +207,18 @@ def _save_final_checkpoint(args, state, iterations, losses):
 
 
 def main(argv=None) -> int:
+    """Arg parse + dispatch.  Wraps :func:`_main` so the trace-time
+    mixed-precision setting (--dtype bfloat16) cannot leak into a later
+    in-process caller (tests call main() directly)."""
+    from tsne_flink_tpu.ops.metrics import matmul_dtype, set_matmul_dtype
+    prev = matmul_dtype()
+    try:
+        return _main(argv)
+    finally:
+        set_matmul_dtype(prev)
+
+
+def _main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
 
@@ -251,7 +265,17 @@ def main(argv=None) -> int:
     from tsne_flink_tpu.parallel.mesh import shard_pipeline
 
     t0 = time.time()
-    dtype = jnp.dtype(args.dtype)
+    if args.dtype == "bfloat16":
+        # MIXED precision, the MXU-native contract: bf16 feeds the distance
+        # matmuls (2x systolic rate), every accumulation / affinity /
+        # optimizer value stays f32.  Casting the whole pipeline to bf16
+        # is measurably fatal (ops/metrics.set_matmul_dtype docstring;
+        # digits trustworthiness 0.771 vs 0.991).
+        from tsne_flink_tpu.ops.metrics import set_matmul_dtype
+        set_matmul_dtype(jnp.bfloat16)
+        dtype = jnp.dtype(jnp.float32)
+    else:
+        dtype = jnp.dtype(args.dtype)
     if jax.default_backend() == "tpu" and args.dtype != "float64":
         # warm the one-time Mosaic lowering probe OUTSIDE any trace, so the
         # in-trace exact_impl=auto decision is a pure cache read
