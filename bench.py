@@ -1,12 +1,12 @@
 """Headline benchmark: MNIST-60k-scale embedding wall-clock on real TPU.
 
-Prints ONE JSON line:
+Prints JSON lines to stdout; the LAST line is the record:
   {"metric": "mnist60k_embed_seconds", "value": <s>, "unit": "s", "vs_baseline": <x>}
 
 Baseline (BASELINE.md): the reference publishes NO numbers; the north-star
 target is "embed MNIST-60k in < 10 s on a TPU v5e-8".  vs_baseline is
-10.0 / measured_seconds (>= 1.0 means the target is met *on however many chips
-are actually present* — here usually ONE v5e chip, i.e. an 8x handicap).
+10.0 / value (>= 1.0 means the target is met *on however many chips are
+actually present* — here usually ONE v5e chip, i.e. an 8x handicap).
 
 The workload takes its shape from BASELINE.json config 2 ("MNIST-60k,
 knnMethod=project, theta=0.5 Barnes-Hut, perplexity=30"): 60k points x 784
@@ -20,6 +20,27 @@ that is what a user who does not reach for the BH knob gets.  The
 explicit-theta BH run (`python bench.py 60000 300 bh`, theta 0.5 — config
 2 verbatim) and the other backends are separate labeled steps in
 scripts/run_tpu_queue.sh; every JSON carries its backend and theta.
+
+WINDOW-PROOFING (round 5; BENCH_r04 recorded nothing because the driver's
+wall-clock window killed the run before the single end-of-run JSON print):
+- a valid JSON line is emitted (and FLUSHED — a SIGKILL mid-window must
+  never find the record sitting in a block buffer) after every stage and
+  after every optimize segment, each superseding the last, so a timeout at
+  ANY point still leaves the best partial record on stdout;
+- the optimize stage runs in fixed-size segments through the optimizer's
+  bit-identical resume path; when the next segment is projected to cross
+  TSNE_BENCH_DEADLINE_S (measured from first process entry, INCLUDING time
+  burned on tunnel attempts), the run stops and the final record linearly
+  extrapolates the remaining iterations, labeled "extrapolated": true with
+  "iterations_run";
+- tunnel-attempt budget shrank (timeout 240->60 s, retries 2->1): a live
+  tunnel initializes in seconds, and round 4 spent 510 s of its window
+  re-probing a dead one;
+- partial records carry "partial": true; their "value" is the best current
+  ESTIMATE of the full metric (remaining stages scaled by the measured
+  FLOP rate so far), with the actually-measured seconds in
+  "measured_seconds" — vs_baseline is computed from the estimate and so is
+  never overstated.
 """
 
 import json
@@ -27,15 +48,56 @@ import os
 import sys
 import time
 
+# This jaxlib's XLA:CPU AOT cache loader LOG(ERROR)s a full CPU-feature dump
+# on EVERY persistent-cache load because the compile-time target spec carries
+# the pseudo-features +prefer-no-gather/scatter that host detection never
+# reports (observed round 4/5: same-host entries spam identically; the entry
+# still loads and the cache measurably works).  Round 4's driver tail was
+# 100% this spam, burying the real diagnostics — silence non-fatal C++ logs
+# in the bench *children* (env inherited via the retry wrapper; FATAL aborts
+# and Python tracebacks still surface).  Must be set before jaxlib loads,
+# which happens at child interpreter start via sitecustomize.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 import numpy as np
 
+DATA_PROVENANCE = "synthetic-blobs"  # no network egress for real MNIST
+DATA_SEED = 0
 
-def make_data(n=60_000, d=784, classes=10, seed=0):
+
+def make_data(n=60_000, d=784, classes=10, seed=DATA_SEED):
     rng = np.random.default_rng(seed)
     centers = rng.random((classes, d)).astype(np.float32)
     labels = rng.integers(0, classes, n)
     x = centers[labels] + 0.15 * rng.standard_normal((n, d)).astype(np.float32)
     return np.clip(x, 0.0, 1.0)
+
+
+def _t0() -> float:
+    """First-entry wall-clock, shared across the retry wrapper's children via
+    the environment so the deadline covers the WHOLE bench invocation."""
+    return float(os.environ.setdefault("TSNE_BENCH_T0", repr(time.time())))
+
+
+def _deadline_s() -> float:
+    return float(os.environ.get("TSNE_BENCH_DEADLINE_S", "570"))
+
+
+def _remaining() -> float:
+    return _t0() + _deadline_s() - time.time()
+
+
+def _emit(rec: dict) -> None:
+    """One superseding JSON record: flushed to stdout (the driver parses the
+    last line that survives its window) and mirrored to a side file."""
+    line = json.dumps(rec)
+    print(line, flush=True)
+    try:
+        os.makedirs("results", exist_ok=True)
+        with open("results/bench_progress.json", "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
 
 
 def _backend_watchdog(timeout_s: float):
@@ -73,13 +135,15 @@ def _run_with_retries():
     lock), so retrying means re-running the bench as a FRESH child process:
     the parent retries rc=3 children with backoff, and — if
     TSNE_BENCH_CPU_FALLBACK=1 — runs a final CPU-pinned child so the round
-    still records a (clearly labeled) number instead of nothing."""
+    still records a (clearly labeled) number instead of nothing.
+
+    Round-5 budget rebalance: one 60 s attempt (a LIVE tunnel answers in
+    seconds), then straight to the CPU child — round 4 burned 510 s of a
+    ~600 s window on two 240 s probes of a tunnel dead since round 1."""
     import subprocess
 
-    # 2 x 240s (not 3 x 300s): two real chances for the tunnel while leaving
-    # the bulk of the driver's bench window for the guaranteed CPU-fallback
-    # run on this 1-core host (~20 min at 60k)
-    retries = max(1, int(os.environ.get("TSNE_BENCH_INIT_RETRIES", "2")))
+    _t0()  # pin the deadline clock before any child starts
+    retries = max(1, int(os.environ.get("TSNE_BENCH_INIT_RETRIES", "1")))
     backoff = float(os.environ.get("TSNE_BENCH_INIT_BACKOFF", "30"))
     env = dict(os.environ, TSNE_BENCH_WRAPPED="1")
     for attempt in range(retries):
@@ -107,7 +171,12 @@ def _run_with_retries():
     sys.exit(3)
 
 
+class _DeadlineStop(Exception):
+    """Raised from the optimize checkpoint callback to stop segmenting."""
+
+
 def main():
+    _t0()
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
 
@@ -116,12 +185,13 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     else:
         _backend_watchdog(
-            float(os.environ.get("TSNE_BENCH_INIT_TIMEOUT", "240")))
+            float(os.environ.get("TSNE_BENCH_INIT_TIMEOUT", "60")))
 
     import jax
     import jax.numpy as jnp
 
-    from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
+    from tsne_flink_tpu.models.tsne import (LOSS_EVERY, TsneConfig,
+                                            init_working_set)
     from tsne_flink_tpu.ops.affinities import affinity_pipeline
     from tsne_flink_tpu.ops.knn import (knn as knn_dispatch,
                                         pick_knn_refine, pick_knn_rounds)
@@ -152,7 +222,8 @@ def main():
         # swept as separate labeled runs (scripts/run_tpu_queue.sh).
         from tsne_flink_tpu.utils.cli import pick_repulsion
         repulsion = pick_repulsion("auto", theta, n, 2, theta_explicit=False)
-    x_np = make_data(n)
+    d_in = 784
+    x_np = make_data(n, d_in)
 
     if jax.default_backend() == "tpu":
         # warm the one-time Mosaic lowering probe outside any trace
@@ -165,7 +236,37 @@ def main():
     k = 90  # 3 * perplexity (Tsne.scala:55)
     # the same auto recall policy the CLI runs: Z-order seed + NN-descent
     rounds = pick_knn_rounds(n)
-    refine = pick_knn_refine(n, int(x_np.shape[1]))
+    refine = pick_knn_refine(n, d_in)
+
+    # ---- analytic FLOP model + MFU (VERDICT r2 weak #2): computed UP FRONT
+    # so every partial record can scale the unmeasured remainder by the
+    # measured FLOP rate, and the record is grade-ready the moment any
+    # wall-clock lands, on whatever backend actually ran
+    from tsne_flink_tpu.utils.flops import (
+        affinity_flops, knn_flops, optimize_flops, peak_flops)
+    backend = jax.default_backend()
+    f_knn = knn_flops(n, d_in, k, "project", rounds=rounds,
+                      refine_rounds=refine)
+    f_aff = affinity_flops(n, k)
+    kind = jax.devices()[0].device_kind if backend == "tpu" else ""
+    peak, basis = peak_flops(backend, kind, jax.device_count())
+
+    base = {
+        "metric": "mnist60k_embed_seconds", "unit": "s",
+        "backend": backend, "devices": jax.device_count(),
+        "n": n, "iterations": iters, "repulsion": repulsion,
+        "theta": cfg.theta, "knn_rounds": rounds, "knn_refine": refine,
+        "data": DATA_PROVENANCE, "data_seed": DATA_SEED,
+        "peak_flops": peak, "peak_flops_basis": basis,
+    }
+
+    def emit_partial(measured_s, est_total_s, stages, note):
+        est = max(float(est_total_s), float(measured_s))
+        _emit({**base, "value": round(est, 3),
+               "vs_baseline": round(10.0 / est, 3), "partial": True,
+               "measured_seconds": round(float(measured_s), 3),
+               "stages": {k_: round(v, 3) for k_, v in stages.items()},
+               "estimate_basis": note})
 
     x = jnp.asarray(x_np)
     t0 = time.time()
@@ -174,6 +275,15 @@ def main():
                                 refine=refine, key=jax.random.key(0)))(x)
     idx.block_until_ready()
     t_knn = time.time() - t0
+    # f_opt is not known exactly until the affinity stage fixes the row
+    # width; use the row-layout upper bound (s <= 2k) for the estimate
+    f_opt_guess = optimize_flops(n, 2 * k, 2, iters, repulsion,
+                                 theta=cfg.theta,
+                                 mpad=8 if backend == "tpu" else 3)
+    rate = f_knn / max(t_knn, 1e-9)
+    emit_partial(t_knn, t_knn + (f_aff + f_opt_guess) / rate,
+                 {"knn": t_knn},
+                 "knn measured; affinities+optimize scaled by knn FLOP rate")
 
     t1 = time.time()
     jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
@@ -182,60 +292,103 @@ def main():
 
     state = init_working_set(jax.random.key(0), n, 2, jnp.float32)
     runner = ShardedOptimizer(cfg, n)
-    t2 = time.time()
-    state, losses = runner(state, jidx, jval)
-    state.y.block_until_ready()
-    t_opt = time.time() - t2
-
-    total = time.time() - t0
-    print(f"# knn={t_knn:.2f}s affinities={t_aff:.2f}s optimize={t_opt:.2f}s "
-          f"({iters} iters, {jax.device_count()} {jax.default_backend()} "
-          f"device(s)), final KL={float(losses[-1]):.4f}", file=sys.stderr)
-
-    # ---- analytic FLOP model + MFU (VERDICT r2 weak #2): grade-ready the
-    # moment a wall-clock lands, on whatever backend actually ran
-    from tsne_flink_tpu.utils.flops import (
-        affinity_flops, knn_flops, optimize_flops, peak_flops)
-    backend = jax.default_backend()
-    s = int(jidx.shape[1])  # true symmetrized row width the optimizer ran
-    # ask the optimizer which attraction layout it actually launched so the
+    s = int(jidx.shape[1])  # true symmetrized row width the optimizer runs
+    # ask the optimizer which attraction layout it actually launches so the
     # FLOP model counts the launched pairs (utils/flops.py) — single- AND
     # multi-device (the decision lives in ONE place: affinities.plan_edges
     # via ShardedOptimizer.attraction_plan)
     layout, pairs, _ = runner.attraction_plan(jidx, jval)
     use_edges = layout == "edges"
-    f_knn = knn_flops(n, int(x_np.shape[1]), k, "project", rounds=rounds,
-                      refine_rounds=refine)
-    f_aff = affinity_flops(n, k)
     f_opt = optimize_flops(n, s, 2, iters, repulsion,
                            nnz_pairs=pairs if use_edges else None,
                            theta=cfg.theta,  # bh auto-frontier mirror
                            mpad=8 if backend == "tpu" else 3)
-    flops = f_knn + f_aff + f_opt
-    kind = jax.devices()[0].device_kind if backend == "tpu" else ""
-    peak, basis = peak_flops(backend, kind, jax.device_count())
-    mfu = round(flops / (total * peak), 5) if peak else None
-    print(json.dumps({
-        "metric": "mnist60k_embed_seconds",
-        "value": round(total, 3),
-        "unit": "s",
-        "vs_baseline": round(10.0 / total, 3),
-        "backend": backend,
-        "devices": jax.device_count(),
-        "stages": {"knn": round(t_knn, 3), "affinities": round(t_aff, 3),
-                   "optimize": round(t_opt, 3)},
-        "stage_flops": {"knn": f_knn, "affinities": f_aff, "optimize": f_opt},
-        "flops": flops,
-        "mfu": mfu,
-        "peak_flops": peak,
-        "peak_flops_basis": basis,
-        "final_kl": round(float(losses[-1]), 4),
-        "n": n, "iterations": iters, "repulsion": repulsion,
-        "theta": cfg.theta,
-        "knn_rounds": rounds, "knn_refine": refine, "sym_width": s,
-        "attraction": layout,
-        "attraction_pairs": pairs,
-    }))
+    rate = (f_knn + f_aff) / max(t_knn + t_aff, 1e-9)
+    emit_partial(t_knn + t_aff, t_knn + t_aff + f_opt / rate,
+                 {"knn": t_knn, "affinities": t_aff},
+                 "knn+affinities measured; optimize scaled by FLOP rate")
+
+    # ---- optimize, in fixed-size bit-identical segments (one compiled
+    # executable — start_iter and the loss trace are traced arguments) with
+    # a superseding record after each; stop when the next segment would
+    # cross the deadline and extrapolate the rest
+    seg = int(os.environ.get("TSNE_BENCH_SEG", "0")) or max(
+        LOSS_EVERY, min(50, iters // 10 or iters))
+    margin = float(os.environ.get("TSNE_BENCH_MARGIN_S", "20"))
+    t2 = time.time()
+    prog = {"it": 0, "state": state, "losses": None,
+            "last_seg_s": None, "t_prev": t2}
+
+    def opt_elapsed():
+        return time.time() - t2
+
+    def est_total_at(it_done):
+        if it_done <= 0:
+            return t_knn + t_aff + f_opt / rate
+        return t_knn + t_aff + opt_elapsed() * iters / it_done
+
+    def cb(state_u, next_iter, losses):
+        jax.block_until_ready(state_u.y)
+        now = time.time()
+        prog.update(it=next_iter, state=state_u, losses=losses,
+                    last_seg_s=now - prog["t_prev"], t_prev=now)
+        measured = t_knn + t_aff + opt_elapsed()
+        emit_partial(measured, est_total_at(next_iter),
+                     {"knn": t_knn, "affinities": t_aff,
+                      "optimize": opt_elapsed()},
+                     f"optimize extrapolated from {next_iter}/{iters} iters")
+        if _remaining() < prog["last_seg_s"] + margin:
+            raise _DeadlineStop
+
+    try:
+        state, losses = runner(state, jidx, jval, checkpoint_every=seg,
+                               checkpoint_cb=cb)
+        it_done = iters
+    except _DeadlineStop:
+        state, losses = prog["state"], prog["losses"]
+        it_done = prog["it"]
+        print(f"# deadline {_deadline_s():.0f}s: stopped after {it_done}/"
+              f"{iters} iters; extrapolating", file=sys.stderr)
+    jax.block_until_ready(state.y)
+    t_opt = time.time() - t2
+
+    complete = it_done == iters
+    total = (t_knn + t_aff + t_opt if complete
+             else est_total_at(it_done))
+    kl_slot = it_done // LOSS_EVERY - 1
+    final_kl = float(losses[min(kl_slot, losses.shape[0] - 1)]) \
+        if kl_slot >= 0 else None
+    print(f"# knn={t_knn:.2f}s affinities={t_aff:.2f}s optimize={t_opt:.2f}s "
+          f"({it_done}/{iters} iters, {jax.device_count()} "
+          f"{jax.default_backend()} device(s)), KL={final_kl}",
+          file=sys.stderr)
+
+    f_opt_done = optimize_flops(n, s, 2, max(it_done, 1), repulsion,
+                                nnz_pairs=pairs if use_edges else None,
+                                theta=cfg.theta,
+                                mpad=8 if backend == "tpu" else 3)
+    flops = f_knn + f_aff + f_opt  # full-workload FLOPs (matches "value")
+    measured_s = t_knn + t_aff + t_opt
+    measured_flops = f_knn + f_aff + (f_opt if complete else f_opt_done)
+    # MFU from MEASURED work over MEASURED time — extrapolation cancels out
+    mfu = round(measured_flops / (measured_s * peak), 5) if peak else None
+    rec = {**base,
+           "value": round(total, 3),
+           "vs_baseline": round(10.0 / total, 3),
+           "stages": {"knn": round(t_knn, 3), "affinities": round(t_aff, 3),
+                      "optimize": round(t_opt, 3)},
+           # stage_flops pairs with the MEASURED "stages" seconds, so an
+           # extrapolated record carries the partial-run optimize FLOPs
+           # (full-workload FLOPs live in "flops", matching "value")
+           "stage_flops": {"knn": f_knn, "affinities": f_aff,
+                           "optimize": f_opt if complete else f_opt_done},
+           "flops": flops, "mfu": mfu,
+           "final_kl": round(final_kl, 4) if final_kl is not None else None,
+           "sym_width": s, "attraction": layout, "attraction_pairs": pairs}
+    if not complete:
+        rec.update(extrapolated=True, iterations_run=it_done,
+                   measured_seconds=round(measured_s, 3))
+    _emit(rec)
 
 
 if __name__ == "__main__":

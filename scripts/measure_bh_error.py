@@ -32,21 +32,31 @@ def clustered_embedding(n, m=2, clusters=10, span=80.0, seed=0):
             + rng.standard_normal((n, m)) * 1.5).astype(np.float32)
 
 
-def _list_arg(flag, default):
-    if flag in sys.argv:
-        return [float(v) if "." in v else int(v)
-                for v in sys.argv[sys.argv.index(flag) + 1].split(",")]
-    return default
+def _parse_args():
+    # argparse, not sys.argv.index() value lookups (ADVICE r4: a positional
+    # equal to a flag value mis-sorted the lists and silently changed n)
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("n", nargs="?", type=int, default=100_000)
+    p.add_argument("sample", nargs="?", type=int, default=2048)
+    list_of_nums = lambda s: [float(v) if "." in v else int(v)
+                              for v in s.split(",")]
+    p.add_argument("--frontiers", type=list_of_nums, default=[16, 32, 64])
+    p.add_argument("--thetas", type=list_of_nums, default=[0.5, 0.25])
+    p.add_argument("--dims", type=int, default=2,
+                   help="embedding dimensionality (2 = quadtree, 3 = octree)")
+    p.add_argument("--auto", action="store_true",
+                   help="also report the auto-frontier policy row")
+    p.add_argument("--levels", type=list_of_nums, default=None,
+                   help="tree depths to sweep (default: the auto policy "
+                        "depth only)")
+    return p.parse_args()
 
 
 def main():
-    pos = [a for a in sys.argv[1:] if not a.startswith("--")
-           and sys.argv[sys.argv.index(a) - 1] not in ("--frontiers",
-                                                       "--thetas")]
-    n = int(pos[0]) if len(pos) > 0 else 100_000
-    sample = int(pos[1]) if len(pos) > 1 else 2048
-    frontiers = _list_arg("--frontiers", [16, 32, 64])
-    thetas = _list_arg("--thetas", [0.5, 0.25])
+    a = _parse_args()
+    n, sample, frontiers, thetas = a.n, a.sample, a.frontiers, a.thetas
+    m_dim = a.dims
 
     import jax
     if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
@@ -59,34 +69,40 @@ def main():
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
 
-    y = jnp.asarray(clustered_embedding(n))
-    print(f"n={n} sample={sample} backend={jax.default_backend()} "
-          f"levels(auto)={default_levels(n, 2)}", flush=True)
+    y = jnp.asarray(clustered_embedding(n, m_dim))
+    print(f"n={n} sample={sample} dims={m_dim} "
+          f"backend={jax.default_backend()} "
+          f"levels(auto)={default_levels(n, m_dim)}", flush=True)
 
     rep_e, _ = jax.jit(lambda a: exact_repulsion(a[:sample], a))(y)
     rep_e.block_until_ready()
     den = float(jnp.max(jnp.linalg.norm(rep_e, axis=1)))
 
+    lv_list = a.levels or [default_levels(n, m_dim)]
     for theta in thetas:
         fr_list = list(frontiers)
-        if "--auto" in sys.argv:
-            fr_auto = default_frontier(n, 2, default_levels(n, 2), theta)
+        if a.auto:
+            fr_auto = default_frontier(n, m_dim, default_levels(n, m_dim),
+                                       theta)
             if fr_auto not in fr_list:
                 fr_list.append(fr_auto)
-        for frontier in fr_list:
-            fn = jax.jit(lambda a, th=theta, fr=frontier: bh_repulsion(
-                a, theta=th, frontier=fr))
-            rep_b, z_b = fn(y)
-            rep_b.block_until_ready()  # compile
-            t0 = time.time()
-            rep_b, z_b = fn(y)
-            rep_b.block_until_ready()
-            dt = time.time() - t0
-            err = float(jnp.max(jnp.linalg.norm(
-                rep_b[:sample] - rep_e, axis=1))) / den
-            print(f"  theta={theta} frontier={frontier:3d}: "
-                  f"{dt * 1000:8.1f} ms/call  max rel err (on {sample} rows) "
-                  f"{err:.3e}", flush=True)
+        for levels in lv_list:
+            for frontier in fr_list:
+                fn = jax.jit(lambda a, th=theta, fr=frontier, lv=levels:
+                             bh_repulsion(a, theta=th, frontier=fr,
+                                          levels=lv))
+                rep_b, z_b = fn(y)
+                rep_b.block_until_ready()  # compile
+                t0 = time.time()
+                rep_b, z_b = fn(y)
+                rep_b.block_until_ready()
+                dt = time.time() - t0
+                err = float(jnp.max(jnp.linalg.norm(
+                    rep_b[:sample] - rep_e, axis=1))) / den
+                print(f"  theta={theta} levels={levels} "
+                      f"frontier={frontier:3d}: {dt * 1000:8.1f} ms/call  "
+                      f"max rel err (on {sample} rows) {err:.3e}",
+                      flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,130 @@
+"""Quality gate at the HEADLINE bench shape (VERDICT r4 next-step #4).
+
+The approximate auto plan (project-kNN at recall ~0.93 + FFT repulsion,
+theta 0.25) is what `python bench.py` times; nothing yet pinned that this
+approximation costs ~nothing in final quality AT 60k.  The reference always
+ties its approximations back to an exact oracle
+(TsneHelpersTestSuite.scala:186-209, theta=0 == exact); this script is that
+oracle run at the bench shape, IN-FAMILY (same framework, same data, same
+iteration schedule — only the approximations differ):
+
+  oracle : bruteforce exact kNN  + exact tiled repulsion
+  auto   : project kNN auto plan + auto repulsion policy (fft at 60k)
+
+Reports, into results/quality_60k.txt:
+  * recall@90 of the auto kNN graph vs the exact graph
+  * final KL of both runs (same k, same perplexity -> comparable supports)
+  * trustworthiness (k=12) of both embeddings on a SAMPLE-point random
+    subsample (full 60k trustworthiness is O(N^2) memory)
+
+tests/test_quality_gate.py asserts the committed bounds so a regression in
+the funnel or the FFT grid shows up as a test failure, not a silent quality
+drift.
+
+Usage: python scripts/quality_60k.py [n] [iters] [sample]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+# fail in < 1 s, not after the ~1 h of embedding runs that precede the
+# trustworthiness computation (code-review r5)
+from sklearn.manifold import trustworthiness
+
+import jax
+
+jax.config.update("jax_platforms",
+                  os.environ.get("TSNE_QUALITY_BACKEND", "cpu"))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("n", nargs="?", type=int, default=60_000)
+    p.add_argument("iters", nargs="?", type=int, default=300)
+    p.add_argument("sample", nargs="?", type=int, default=5000)
+    a = p.parse_args()
+
+    import jax.numpy as jnp
+
+    from bench import make_data
+    from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
+    from tsne_flink_tpu.ops.affinities import affinity_pipeline
+    from tsne_flink_tpu.ops.knn import (knn as knn_dispatch, pick_knn_refine,
+                                        pick_knn_rounds)
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    n, iters, sample = a.n, a.iters, a.sample
+    k = 90
+    x_np = make_data(n)
+    x = jnp.asarray(x_np)
+
+    def run(tag, knn_method, repulsion, theta, rounds=0, refine=0):
+        t0 = time.time()
+        if knn_method == "project":
+            idx, dist = jax.jit(lambda xx: knn_dispatch(
+                xx, k, "project", rounds=rounds, refine=refine,
+                key=jax.random.key(0)))(x)
+        else:
+            idx, dist = jax.jit(
+                lambda xx: knn_dispatch(xx, k, knn_method))(x)
+        idx.block_until_ready()
+        t_knn = time.time() - t0
+        jidx, jval = affinity_pipeline(idx, dist, 30.0)
+        jval.block_until_ready()
+        cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=theta,
+                         repulsion=repulsion, row_chunk=4096)
+        state = init_working_set(jax.random.key(0), n, 2, jnp.float32)
+        runner = ShardedOptimizer(cfg, n)
+        state, losses = runner(state, jidx, jval)
+        y = np.asarray(state.y)
+        kl = float(losses[-1])
+        dt = time.time() - t0
+        print(f"{tag}: knn={t_knn:.1f}s total={dt:.1f}s KL={kl:.4f}",
+              flush=True)
+        return idx, y, kl, dt
+
+    out = {"n": n, "iters": iters, "sample": sample, "k": k,
+           "data": "synthetic-blobs", "data_seed": 0}
+
+    rounds, refine = pick_knn_rounds(n), pick_knn_refine(n, x_np.shape[1])
+    idx_a, y_a, kl_a, t_a = run("auto  ", "project", "fft", 0.25,
+                                rounds, refine)
+    out.update(auto_kl=round(kl_a, 4), auto_seconds=round(t_a, 1),
+               auto_rounds=rounds, auto_refine=refine)
+
+    idx_e, y_e, kl_e, t_e = run("oracle", "bruteforce", "exact", 0.0)
+    out.update(oracle_kl=round(kl_e, 4), oracle_seconds=round(t_e, 1))
+
+    # recall@k of the auto graph against the exact graph (row-set overlap)
+    hits = sum(len(np.intersect1d(idx_a[i], idx_e[i]))
+               for i in range(0, n, max(1, n // 4096)))
+    rows = len(range(0, n, max(1, n // 4096)))
+    recall = hits / (rows * k)
+    out["auto_knn_recall"] = round(recall, 4)
+
+    rng = np.random.default_rng(0)
+    sub = rng.choice(n, size=min(sample, n), replace=False)
+    tw_a = trustworthiness(x_np[sub], y_a[sub], n_neighbors=12)
+    tw_e = trustworthiness(x_np[sub], y_e[sub], n_neighbors=12)
+    out.update(auto_trustworthiness=round(float(tw_a), 4),
+               oracle_trustworthiness=round(float(tw_e), 4),
+               delta_kl=round(kl_a - kl_e, 4),
+               delta_trustworthiness=round(float(tw_a - tw_e), 4))
+
+    os.makedirs("results", exist_ok=True)
+    with open("results/quality_60k.txt", "w") as f:
+        f.write(json.dumps(out) + "\n")
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,13 @@ export TSNE_BENCH_INIT_TIMEOUT=240 TSNE_BENCH_INIT_RETRIES=2
 step() {
   local name=$1; shift
   echo "=== $name: $* [$(date +%H:%M:%S)]" | tee -a $Q/queue.log
-  timeout "$STEP_TIMEOUT" "$@" > "$Q/$name.log" 2>&1
+  # the queue runs with the tunnel already probed alive and generous
+  # per-step timeouts — track each step's own window (minus a stop/emit
+  # margin) so bench.py's segmented optimize never truncates a queue run
+  # whose budget was still open (code-review r5: one global value sat
+  # below the 2400 s steps)
+  TSNE_BENCH_DEADLINE_S=$((STEP_TIMEOUT - 100)) \
+    timeout "$STEP_TIMEOUT" "$@" > "$Q/$name.log" 2>&1
   echo "=== $name rc=$? [$(date +%H:%M:%S)]" | tee -a $Q/queue.log
 }
 
@@ -36,6 +42,13 @@ STEP_TIMEOUT=1800 step recall_60k python scripts/measure_recall.py 60000 784 90 
 STEP_TIMEOUT=3600 step baseline_full python scripts/run_baseline_configs.py --scale 1
 # 7. BH at 100k with error vs exact subsample
 STEP_TIMEOUT=1800 step bh_100k python scripts/measure_bh_error.py 100000
+# 7b. 3-D octree frontier calibration on hardware (BASELINE config 3 is 3-D)
+STEP_TIMEOUT=1800 step bh_100k_3d python scripts/measure_bh_error.py 100000 \
+  --dims 3 --auto
 # 8. stage profile at 60k
 STEP_TIMEOUT=1200 step profile_60k python scripts/profile_stages.py 60000 50 fft
+# 9. quality gate at the bench shape (fast on-chip; ~1 h on CPU) — the
+# script pins CPU unless told otherwise, so point it at the chip here
+STEP_TIMEOUT=3600 step quality_60k env TSNE_QUALITY_BACKEND=tpu \
+  python scripts/quality_60k.py
 echo "=== queue complete [$(date +%H:%M:%S)]" | tee -a $Q/queue.log

@@ -250,10 +250,10 @@ def assemble_edges(jidx: jnp.ndarray, jval: jnp.ndarray, e_pad: int):
 def edges_beneficial(e_pad: int, n_rows: int, s: int) -> bool:
     """THE auto-mode benefit gate: the edge layout wins when its (padded)
     edge count is at most half the row layout's ``rows x S`` launched pairs.
-    Shared by :func:`plan_edges` (host paths, exact nnz) and the fused
-    ``SpmdPipeline`` gate (in-trace, which must size from the out+in upper
-    bound instead — the estimator differs by necessity, the threshold must
-    not)."""
+    Shared by :func:`plan_edges` (host paths) and the fused ``SpmdPipeline``
+    gate (in-trace) — since round 4 BOTH size from the exact pre-truncation
+    distinct-entry edge count threaded out of :func:`assemble_rows`, so the
+    gate compares the same quantity everywhere."""
     return e_pad <= (n_rows * s) // 2
 
 

@@ -35,8 +35,16 @@ _MATMUL_DTYPE = None
 
 
 def set_matmul_dtype(dtype) -> None:
-    """Set the distance-matmul operand dtype (trace-time; call before the
-    first jit of the run, as the CLI/estimator do)."""
+    """Set the distance-matmul operand dtype (trace-time process-global).
+
+    CONTRACT (ADVICE r4): single-threaded, set BEFORE the first trace and
+    leave in place for the run — the value is baked into any jit cache or
+    held executable at trace time, so flipping it later silently leaves
+    stale-dtype programs in caches that outlive the fit (e.g. a
+    ``ShardedOptimizer._fns`` entry kept by a caller).  ``TSNE.fit`` and
+    ``cli.main`` set it, run, and restore in a ``finally`` for exactly this
+    reason; direct ops users must follow the same set-once discipline, and
+    concurrent estimators with different dtypes are not supported."""
     global _MATMUL_DTYPE
     _MATMUL_DTYPE = None if dtype is None else jnp.dtype(dtype)
 
@@ -87,7 +95,10 @@ def metric_fn(metric: str):
         def f(a, b):
             num = jnp.sum(a * b, axis=-1)
             den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
-            return 1.0 - num / den
+            # clamped like the accelerator matmul path's norm cache
+            # (knn._cand_exact), so a zero-norm row gives the same finite
+            # distance on every backend instead of NaN on CPU (ADVICE r4)
+            return 1.0 - num / jnp.maximum(den, 1e-12)
 
     return f
 

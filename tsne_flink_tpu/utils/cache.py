@@ -5,21 +5,72 @@ tunnel; the cache makes every run after the first start instantly — the
 moral equivalent of the reference resubmitting an already-built Flink job
 graph.  Library imports do NOT enable this implicitly; ``bench.py``, the CLI
 and ``__graft_entry__`` call :func:`enable_compilation_cache` explicitly.
+
+Entries are keyed by a HOST SIGNATURE subdirectory (round-5 fix): XLA:CPU
+AOT-compiles against the build host's exact CPU feature set, and loading an
+entry produced on a different machine at best forces a recompile storm and
+at worst risks SIGILL (BENCH_r04: ``cpu_aot_loader.cc`` "machine features
+don't match" spam consumed the whole driver window).  Hashing the CPU flag
+set into the cache path means a foreign host's entries are simply never
+seen; stale top-level entries from the pre-signature scheme are swept.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def host_signature() -> str:
+    """12-hex digest of this machine's CPU feature set + arch + python ABI.
+
+    /proc/cpuinfo ``flags`` is exactly the feature list XLA:CPU's AOT loader
+    compares (cpu_aot_loader.cc), so two hosts share a signature only when
+    their compiled code is mutually executable.
+    """
+    parts = [platform.machine(), platform.python_version()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("flags", "features")):
+                    parts.append(" ".join(sorted(line.split(":", 1)[1]
+                                                 .split())))
+                    break
+    except OSError:
+        parts.append(platform.processor() or "unknown")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
+def _sweep_legacy_entries(root: str) -> None:
+    """Remove pre-round-5 top-level cache files (unknown build host, proven
+    foreign in BENCH_r04) so they can never be loaded again.  Only plain
+    files are swept; host-signature subdirectories are kept."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return
+    for name in names:
+        p = os.path.join(root, name)
+        if os.path.isfile(p):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
 
 def enable_compilation_cache(path: str | None = None) -> None:
     import jax
 
     if path is None:
-        path = os.environ.get(
-            "TSNE_TPU_CACHE_DIR",
-            os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))), ".jax_cache"))
+        root = os.environ.get("TSNE_TPU_CACHE_DIR")
+        if root is None:
+            root = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+            # sweep ONLY the repo-default root — a user-supplied
+            # TSNE_TPU_CACHE_DIR may hold unrelated files (code-review r5)
+            _sweep_legacy_entries(root)
+        path = os.path.join(root, host_signature())
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
