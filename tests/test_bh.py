@@ -127,8 +127,12 @@ def test_bh_inside_optimizer_runs():
 def test_default_levels_sane():
     assert default_levels(1000, 2) == 8
     assert default_levels(10 ** 6, 2) == 11  # memory cap
-    assert default_levels(10 ** 6, 3) == 7   # memory cap
+    assert default_levels(10 ** 6, 3) == 9   # memory cap (round-5 raise)
     assert default_levels(300, 2) == 8       # measured error plateau
+    # 3-D depth tracks the 2-D per-axis resolution policy, not uniform
+    # occupancy (round-5 fix: results/bh_error_3d.txt)
+    assert default_levels(2000, 3) == 9
+    assert default_levels(50_000, 3) == 9
 
 
 def test_bh_error_bounded_under_frontier_pressure():

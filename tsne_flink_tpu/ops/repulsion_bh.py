@@ -52,15 +52,33 @@ from jax import lax
 
 #: Morton bit budget per dimension must keep ids in int32
 MAX_LEVELS = {2: 15, 3: 10}
-#: dense per-level arrays cost (2^m)^L cells — cap the memory at ~4M cells
-MEM_LEVELS = {2: 11, 3: 7}
+#: dense per-level arrays cost (2^m)^L cells.  2-D: 4^11 = 4M cells (64 MB
+#: of f32 count+sum at the leaf level).  3-D: 8^9 = 134M cells — ~2.1 GB
+#: transient at the leaf level, affordable on a v5e (16 GB HBM) and
+#: measured NECESSARY (round 5): capping at 7 left 50k-class clustered
+#: embeddings with 9.3e-2 max force error *even at theta=0* (leaves far
+#: from singleton), vs 8.9e-3 at 9 (results/bh_error_3d.txt).  The dense
+#: arrays are sized 8^levels INDEPENDENT of n, so small-n 3-D callers pay
+#: the same ~2 GB transient; that is confined to EXPLICIT --repulsion bh
+#: use — the auto policy routes n <= 32768 to exact (cli.pick_repulsion),
+#: and direct callers can pass ``levels=`` to trade error for memory.
+MEM_LEVELS = {2: 11, 3: 9}
 
 
 def default_levels(n: int, m: int) -> int:
-    """Deep enough that clustered points still resolve to ~singleton leaves
-    (measured: error plateaus ~3 levels past the uniform-occupancy depth),
-    capped by the dense-array memory budget."""
-    want = math.ceil(math.log(max(n, 2), 2**m)) + 3
+    """Deep enough that clustered points still resolve to ~singleton leaves,
+    capped by the dense-array memory budget.
+
+    ``levels`` is bits PER AXIS, so equal-resolution across m means equal
+    ``levels``, while the uniform-occupancy depth ``log_{2^m} n`` shrinks
+    with m — the round-4 formula used the latter and under-resolved every
+    3-D tree by 2 levels (1.2e-1 max force error at the n=2k..50k defaults,
+    theta-independent — a LEAF-resolution error, not a gate error).  The
+    policy is therefore the measured 2-D one, ``ceil(log4 n) + 3``, for
+    both m: identical to before at m=2, and at m=3 it restores 2-D-parity
+    error (n=2000: levels 9 -> 1.28e-2 vs 7 -> 1.22e-1; n=50000: levels 9
+    -> 8.9e-3 vs 7 -> 9.3e-2; results/bh_error_3d.txt)."""
+    want = math.ceil(math.log(max(n, 2), 4)) + 3
     return max(2, min(MEM_LEVELS[m], MAX_LEVELS[m], want))
 
 
@@ -78,14 +96,21 @@ def default_frontier(n: int, m: int, levels: int | None = None,
     frontier 32 through 256 at 250k; same at 1M), and at theta=0.25 it
     converges by frontier 64 (4.6e-3 at 32 -> 2.9e-3 at 64 == 128 == 256),
     with the same plateau points at 50k (results/bh_error_50k.txt), 250k
-    and 1M (11 levels).  Hence ``16/theta^(m-1)``: 32 at theta=0.5 and 64
-    at theta=0.25 in 2-D; the untested 3-D shell gets the analogous
-    ``theta^-2`` scaling.  Clamped to [16, 256] — per-point level cost is
-    frontier x 2^m cell visits.  ``n``/``levels`` are accepted for API
-    symmetry with :func:`default_levels` but deliberately unused (measured
-    depth-invariance above)."""
+    and 1M (11 levels).  Hence ``16/theta`` in 2-D: 32 at theta=0.5, 64 at
+    theta=0.25.
+
+    3-D is MEASURED too (round 5, results/bh_error_3d.txt, at the fixed
+    round-5 depth): the r4 ``theta^-2`` analogy had the right exponent but
+    a 2x-too-wide prefactor — at 50k/levels 9 the error plateaus at
+    frontier 32 for theta=0.5 (9.3e-3; 64 and 128 identical 8.9e-3) and
+    reaches 2-D-parity 3.7e-3 at 128 for theta=0.25 — hence ``8/theta^2``:
+    32 at theta=0.5, 128 at theta=0.25.  Clamped to [16, 256] — per-point
+    level cost is frontier x 2^m cell visits.  ``n``/``levels`` are
+    accepted for API symmetry with :func:`default_levels` but deliberately
+    unused (measured depth-invariance above)."""
     del n, levels
-    f = int(16.0 / max(theta, 0.05) ** (m - 1))
+    t = max(theta, 0.05)
+    f = int(16.0 / t) if m == 2 else int(8.0 / t ** 2)
     return max(16, min(256, 8 * ((f + 7) // 8)))
 
 
