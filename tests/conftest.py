@@ -21,7 +21,7 @@ import pytest
 _FAST_MODULES = {
     "test_golden_reference", "test_affinities", "test_optimizer",
     "test_flops", "test_edge_cases", "test_native_io", "test_pallas",
-    "test_checkpoint", "test_cli", "test_quality_gate",
+    "test_checkpoint", "test_cli", "test_quality_gate", "test_cache",
 }
 
 

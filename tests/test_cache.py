@@ -1,0 +1,73 @@
+"""Host-signature compilation-cache keying (round 5).
+
+BENCH_r04 lost its whole window to XLA:CPU AOT entries compiled on a
+different machine (cpu_aot_loader feature-mismatch spam, SIGILL risk); the
+fix keys the cache directory by a digest of this host's CPU feature set so
+foreign entries are never even visible.  These tests pin the signature's
+stability and the directory layout contract.
+"""
+
+import os
+
+import jax
+import pytest
+
+from tsne_flink_tpu.utils.cache import (enable_compilation_cache,
+                                        host_signature)
+
+
+def test_host_signature_stable_and_wellformed():
+    a, b = host_signature(), host_signature()
+    assert a == b, "signature must be deterministic within a host"
+    assert len(a) == 12 and int(a, 16) >= 0  # 12 hex chars
+
+
+def test_cache_dir_is_host_keyed(tmp_path, monkeypatch):
+    monkeypatch.setenv("TSNE_TPU_CACHE_DIR", str(tmp_path))
+    # a user-supplied root must NOT be swept (code-review r5): unrelated
+    # files at its top level stay put
+    bystander = tmp_path / "unrelated.txt"
+    bystander.write_text("keep me")
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        enable_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == str(
+            tmp_path / host_signature())
+        assert os.path.isdir(tmp_path / host_signature())
+        assert bystander.read_text() == "keep me"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_default_root_sweeps_legacy_entries_only(tmp_path, monkeypatch):
+    """The round-5 fix itself: unkeyed top-level entries (unknown build
+    host — the BENCH_r04 recompile-storm/SIGILL source) are deleted from
+    the DEFAULT root, while host-signature subdirectories survive."""
+    from tsne_flink_tpu.utils import cache as cache_mod
+    monkeypatch.delenv("TSNE_TPU_CACHE_DIR", raising=False)
+    monkeypatch.setattr(cache_mod, "_default_root", lambda: str(tmp_path))
+    legacy = tmp_path / "jit_foo-deadbeef-cache"
+    legacy.write_bytes(b"foreign aot entry")
+    keyed = tmp_path / "0123456789ab"
+    keyed.mkdir()
+    survivor = keyed / "jit_bar-cache"
+    survivor.write_bytes(b"host-keyed entry")
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        cache_mod.enable_compilation_cache()
+        assert not legacy.exists(), "legacy top-level entry must be swept"
+        assert survivor.read_bytes() == b"host-keyed entry"
+        assert jax.config.jax_compilation_cache_dir == str(
+            tmp_path / cache_mod.host_signature())
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_explicit_path_wins(tmp_path):
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        enable_compilation_cache(str(tmp_path / "explicit"))
+        assert jax.config.jax_compilation_cache_dir == str(
+            tmp_path / "explicit")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)

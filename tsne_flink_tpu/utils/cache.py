@@ -59,14 +59,19 @@ def _sweep_legacy_entries(root: str) -> None:
                 pass
 
 
+def _default_root() -> str:
+    """Repo-local cache root (separate function so tests can patch it)."""
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+
 def enable_compilation_cache(path: str | None = None) -> None:
     import jax
 
     if path is None:
         root = os.environ.get("TSNE_TPU_CACHE_DIR")
         if root is None:
-            root = os.path.join(os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+            root = _default_root()
             # sweep ONLY the repo-default root — a user-supplied
             # TSNE_TPU_CACHE_DIR may hold unrelated files (code-review r5)
             _sweep_legacy_entries(root)
