@@ -1,0 +1,63 @@
+"""The bench's window-proofing contract (round 5).
+
+BENCH_r04 recorded nothing because the single end-of-run JSON print never
+survived the driver's wall-clock kill.  These tests pin the fix at a tiny
+shape: every line bench.py emits on stdout must parse as a standalone JSON
+record carrying the grading fields, records must appear DURING the run (not
+only at the end), and a deadline abort must still end with a valid,
+clearly-labeled extrapolated record.
+
+Subprocess tests (the contract is about what another process observes on
+stdout), so they carry the slow marker via conftest's default tiering.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data"}
+
+
+def run_bench(n, iters, extra_env=None, timeout=600):
+    env = dict(os.environ, TSNE_FORCE_CPU="1", TSNE_BENCH_WRAPPED="1")
+    # hermetic: ambient bench-driver knobs must not steer these cases
+    # (each case pins its own deadline clock and knobs via extra_env)
+    for knob in ("TSNE_BENCH_T0", "TSNE_BENCH_DEADLINE_S",
+                 "TSNE_BENCH_MARGIN_S", "TSNE_BENCH_SEG"):
+        env.pop(knob, None)
+    env.update(extra_env or {})
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                        str(n), str(iters)], capture_output=True, text=True,
+                       env=env, timeout=timeout, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert recs, f"no JSON records on stdout; stderr: {r.stderr[-500:]}"
+    return recs
+
+
+def test_every_line_is_a_complete_record():
+    recs = run_bench(800, 40)
+    # per-stage + per-segment emission: knn, affinities, >=1 segment, final
+    assert len(recs) >= 3
+    for rec in recs:
+        assert REQUIRED <= set(rec), rec
+        assert rec["value"] > 0 and rec["unit"] == "s"
+    partials, final = recs[:-1], recs[-1]
+    assert all(p.get("partial") for p in partials)
+    assert "partial" not in final and "extrapolated" not in final
+    assert final["final_kl"] is not None
+    assert final["data"] == "synthetic-blobs"
+
+
+def test_deadline_stop_leaves_labeled_extrapolation():
+    # knn+affinities at n=800 take a few seconds; a deadline that expires
+    # during the first optimize segments forces the _DeadlineStop path
+    recs = run_bench(800, 200, {"TSNE_BENCH_DEADLINE_S": "12",
+                                "TSNE_BENCH_MARGIN_S": "2"})
+    final = recs[-1]
+    assert final.get("extrapolated") is True
+    assert 0 < final["iterations_run"] < 200
+    assert final["measured_seconds"] <= final["value"] * 1.001

@@ -4,7 +4,7 @@ BENCH_r04 lost its whole window to XLA:CPU AOT entries compiled on a
 different machine (cpu_aot_loader feature-mismatch spam, SIGILL risk); the
 fix keys the cache directory by a digest of this host's CPU feature set so
 foreign entries are never even visible.  These tests pin the signature's
-stability and the directory layout contract.
+stability, the directory layout contract, and the legacy sweep itself.
 """
 
 import os
@@ -12,8 +12,23 @@ import os
 import jax
 import pytest
 
+from tsne_flink_tpu.utils import cache as cache_mod
 from tsne_flink_tpu.utils.cache import (enable_compilation_cache,
                                         host_signature)
+
+
+@pytest.fixture(autouse=True)
+def _restore_cache_config():
+    """enable_compilation_cache mutates three jax config globals; snapshot
+    and restore all of them so the rest of the in-process suite is
+    unaffected."""
+    keys = ("jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes")
+    saved = {k: getattr(jax.config, k) for k in keys}
+    yield
+    for k, v in saved.items():
+        jax.config.update(k, v)
 
 
 def test_host_signature_stable_and_wellformed():
@@ -28,22 +43,17 @@ def test_cache_dir_is_host_keyed(tmp_path, monkeypatch):
     # files at its top level stay put
     bystander = tmp_path / "unrelated.txt"
     bystander.write_text("keep me")
-    old = jax.config.jax_compilation_cache_dir
-    try:
-        enable_compilation_cache()
-        assert jax.config.jax_compilation_cache_dir == str(
-            tmp_path / host_signature())
-        assert os.path.isdir(tmp_path / host_signature())
-        assert bystander.read_text() == "keep me"
-    finally:
-        jax.config.update("jax_compilation_cache_dir", old)
+    enable_compilation_cache()
+    assert jax.config.jax_compilation_cache_dir == str(
+        tmp_path / host_signature())
+    assert os.path.isdir(tmp_path / host_signature())
+    assert bystander.read_text() == "keep me"
 
 
 def test_default_root_sweeps_legacy_entries_only(tmp_path, monkeypatch):
     """The round-5 fix itself: unkeyed top-level entries (unknown build
     host — the BENCH_r04 recompile-storm/SIGILL source) are deleted from
     the DEFAULT root, while host-signature subdirectories survive."""
-    from tsne_flink_tpu.utils import cache as cache_mod
     monkeypatch.delenv("TSNE_TPU_CACHE_DIR", raising=False)
     monkeypatch.setattr(cache_mod, "_default_root", lambda: str(tmp_path))
     legacy = tmp_path / "jit_foo-deadbeef-cache"
@@ -52,22 +62,14 @@ def test_default_root_sweeps_legacy_entries_only(tmp_path, monkeypatch):
     keyed.mkdir()
     survivor = keyed / "jit_bar-cache"
     survivor.write_bytes(b"host-keyed entry")
-    old = jax.config.jax_compilation_cache_dir
-    try:
-        cache_mod.enable_compilation_cache()
-        assert not legacy.exists(), "legacy top-level entry must be swept"
-        assert survivor.read_bytes() == b"host-keyed entry"
-        assert jax.config.jax_compilation_cache_dir == str(
-            tmp_path / cache_mod.host_signature())
-    finally:
-        jax.config.update("jax_compilation_cache_dir", old)
+    cache_mod.enable_compilation_cache()
+    assert not legacy.exists(), "legacy top-level entry must be swept"
+    assert survivor.read_bytes() == b"host-keyed entry"
+    assert jax.config.jax_compilation_cache_dir == str(
+        tmp_path / cache_mod.host_signature())
 
 
 def test_explicit_path_wins(tmp_path):
-    old = jax.config.jax_compilation_cache_dir
-    try:
-        enable_compilation_cache(str(tmp_path / "explicit"))
-        assert jax.config.jax_compilation_cache_dir == str(
-            tmp_path / "explicit")
-    finally:
-        jax.config.update("jax_compilation_cache_dir", old)
+    enable_compilation_cache(str(tmp_path / "explicit"))
+    assert jax.config.jax_compilation_cache_dir == str(
+        tmp_path / "explicit")
