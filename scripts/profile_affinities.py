@@ -1,0 +1,90 @@
+"""Decompose the affinity stage's on-chip wall time (round 5).
+
+First TPU contact measured the 60k affinity stage at 94.6-140.8 s on-chip
+vs 9.8 s on the 1-core CPU host (.tpu_queue/bench_60k_fft{,_rows}.log) —
+a ~10x inversion on a stage with only ~5 GFLOP of math, while the matmul
+stages (kNN) run 13x FASTER on-chip.  This script times each jitted
+sub-stage separately (compile rep then steady reps with block_until_ready)
+so the regression can be attributed: beta bisection | width sizing |
+sort+segment-sum assembly | the [N, S] padded scatter.
+
+Usage: python scripts/profile_affinities.py [N] [K] [REPS]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 90
+    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+
+    import jax
+    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from functools import partial
+
+    from tsne_flink_tpu.ops import affinities as aff
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    enable_compilation_cache()
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(0)
+    # kNN-shaped inputs: sorted nonneg distances, arbitrary neighbor ids
+    dist = np.sort(rng.random((n, k)).astype(np.float32), axis=1)
+    idx = np.empty((n, k), np.int32)
+    for h in range(0, n, 4096):  # hub-free base graph
+        e = min(n, h + 4096)
+        idx[h:e] = (rng.integers(1, n, (e - h, k)) + np.arange(h, e)[:, None]) % n
+    # graft a hub so sym_width matches the bench's hub-heavy regime
+    hub_rows = rng.choice(n, min(3500, n // 2), replace=False)
+    idx[hub_rows, 0] = 7
+    dist_d = jnp.asarray(dist)
+    idx_d = jnp.asarray(idx)
+
+    def timed(name, fn, *args):
+        out = jax.block_until_ready(fn(*args))
+        t_steady = []
+        for _ in range(reps):
+            t0 = time.time()
+            out = jax.block_until_ready(fn(*args))
+            t_steady.append(time.time() - t0)
+        print(json.dumps({"stage": name, "backend": backend,
+                          "steady_s": round(min(t_steady), 3),
+                          "all_s": [round(t, 3) for t in t_steady]}),
+              flush=True)
+        return out
+
+    p = timed("beta_bisection", jax.jit(aff.pairwise_affinities,
+                                        static_argnums=1), dist_d, 30.0)
+    w = timed("symmetrized_width", jax.jit(aff.symmetrized_width), idx_d, p)
+    sym_width = int(w)
+    print(json.dumps({"stage": "width_value", "sym_width": sym_width}),
+          flush=True)
+    timed("joint_distribution", jax.jit(partial(
+        aff.joint_distribution, sym_width=sym_width)), idx_d, p)
+
+    # assembly alone (the sort + segment-sum + scatter core), to split it
+    # from the [N, S] normalize/where traffic in joint_distribution
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k))
+    ii = jnp.concatenate([rows.reshape(-1), idx_d.reshape(-1)])
+    jj = jnp.concatenate([idx_d.reshape(-1), rows.reshape(-1)])
+    vv = jnp.concatenate([p.reshape(-1), p.reshape(-1)])
+    timed("assemble_rows_core", jax.jit(partial(
+        aff.assemble_rows, n_rows=n, sym_width=sym_width)), ii, jj, vv)
+
+    # end-to-end, as bench.py calls it
+    timed("affinity_pipeline_e2e", lambda d, i: aff.affinity_pipeline(
+        i, d, 30.0), dist_d, idx_d)
+
+
+if __name__ == "__main__":
+    main()
