@@ -44,9 +44,15 @@ def main():
     for h in range(0, n, 4096):  # hub-free base graph
         e = min(n, h + 4096)
         idx[h:e] = (rng.integers(1, n, (e - h, k)) + np.arange(h, e)[:, None]) % n
-    # graft a hub so sym_width matches the bench's hub-heavy regime
-    hub_rows = rng.choice(n, min(3500, n // 2), replace=False)
-    idx[hub_rows, 0] = 7
+    # graft a hub so sym_width matches the bench's hub-heavy regime;
+    # only rows that don't already list the hub (and not the hub itself)
+    # are eligible, preserving the split path's distinct-ids precondition
+    hub = 7
+    eligible = np.flatnonzero((idx != hub).all(axis=1)
+                              & (np.arange(n) != hub))
+    hub_rows = rng.choice(eligible, min(3500, eligible.size // 2),
+                          replace=False)
+    idx[hub_rows, 0] = hub
     dist_d = jnp.asarray(dist)
     idx_d = jnp.asarray(idx)
 
@@ -81,9 +87,47 @@ def main():
     timed("assemble_rows_core", jax.jit(partial(
         aff.assemble_rows, n_rows=n, sym_width=sym_width)), ii, jj, vv)
 
-    # end-to-end, as bench.py calls it
+    # micro-stages: attribute assemble_rows' time to sort vs scatter, and
+    # time the cheaper candidate forms a redesign would use
+    e = ii.shape[0]
+    timed("sort_2key_3op", jax.jit(lambda a, b, c: jax.lax.sort(
+        (a, b, c), num_keys=2)), ii, jj, vv)
+    timed("sort_1key_3op", jax.jit(lambda a, b, c: jax.lax.sort(
+        (a, b, c), num_keys=1)), ii, jj, vv)
+    half = e // 2
+    timed("sort_1key_3op_half", jax.jit(lambda a, b, c: jax.lax.sort(
+        (a, b, c), num_keys=1)), ii[:half], jj[:half], vv[:half])
+
+    def scatter_only(iis, col, val):
+        z = jnp.zeros((n + 1, sym_width), val.dtype)
+        return z.at[iis, col].set(val, mode="drop")[:n]
+    cols = (jnp.arange(e, dtype=jnp.int32) % sym_width)
+    timed("scatter_NxS", jax.jit(scatter_only), ii, cols, vv)
+
+    def segsum_runs(iis, val):
+        first = jnp.concatenate([jnp.ones((1,), bool), iis[1:] != iis[:-1]])
+        run = jnp.cumsum(first) - 1
+        return jax.ops.segment_sum(val, run, num_segments=e)
+    timed("cumsum_segment_sum", jax.jit(segsum_runs), ii, vv)
+
+    # the membership-test reverse sum a sort-free redesign would rely on:
+    # rev[i,a] = sum_b p[j,b] * (idx[j,b] == i),  j = idx[i,a]
+    def reverse_membership(idx_, p_):
+        nbr = idx_[idx_]                          # [n, k, k]
+        own = jnp.arange(n, dtype=jnp.int32)[:, None, None]
+        return jnp.sum(p_[idx_] * (nbr == own), axis=-1)
+    timed("reverse_membership", jax.jit(reverse_membership), idx_d, p)
+
+    # the round-5 split assembly (gather-merge + 1-key sort, no scatter)
+    w_split = timed("split_width", jax.jit(aff.split_width), idx_d, p)
+    timed("joint_distribution_split", jax.jit(partial(
+        aff.joint_distribution_split, sym_width=int(w_split))), idx_d, p)
+
+    # end-to-end, as bench.py calls it (sorted vs split)
     timed("affinity_pipeline_e2e", lambda d, i: aff.affinity_pipeline(
         i, d, 30.0), dist_d, idx_d)
+    timed("affinity_pipeline_e2e_split", lambda d, i: aff.affinity_pipeline(
+        i, d, 30.0, assembly="split"), dist_d, idx_d)
 
 
 if __name__ == "__main__":

@@ -125,11 +125,13 @@ def main():
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             " --xla_force_host_platform_device_count=8")
         env["TSNE_FORCE_CPU"] = "1"  # honored by the CLI (test/dev escape)
-        # the PARENT's own jax work (make_knn_coo's kNN generation) must
-        # honor the backend choice too — with only the child env set, the
-        # config-4 generator grabbed the live TPU chip mid-queue and
-        # crashed the worker another process was benching on
-        os.environ["TSNE_FORCE_CPU"] = "1"
+    # the PARENT process never touches the accelerator, on ANY backend
+    # (set AFTER the child env copy above, so children follow --backend):
+    # input generation is outside the measured workload, and the chip is
+    # single-tenant — the config-4 kNN generator once grabbed it mid-queue
+    # and crashed the TPU worker the benched CHILD was using (code-review
+    # r5 hardened this from cpu-backend-only to unconditional)
+    os.environ["TSNE_FORCE_CPU"] = "1"
 
     tmp = tempfile.mkdtemp(prefix="tsne_baseline_")
 

@@ -19,7 +19,8 @@ import pytest
 # subprocess and large-shape suites — is marked slow.  A test already
 # carrying an explicit fast/slow marker is left alone.
 _FAST_MODULES = {
-    "test_golden_reference", "test_affinities", "test_optimizer",
+    "test_golden_reference", "test_affinities", "test_affinities_split",
+    "test_optimizer",
     "test_flops", "test_edge_cases", "test_native_io", "test_pallas",
     "test_checkpoint", "test_cli", "test_quality_gate", "test_cache",
 }

@@ -1,0 +1,201 @@
+"""Split (gather-merge) symmetrization == sorted symmetrization.
+
+Round-5 on-chip profiling showed the sorted assembly's 2-key ``lax.sort``
++ [N, S] scatter dominating the affinity stage on TPU (94-141 s at 60k vs
+9.8 s CPU).  :func:`joint_distribution_split` rebuilds the same joint
+distribution from gathers + ONE single-key sort; these tests pin that the
+two layouts encode the SAME P — row-wise identical (neighbor, value)
+multisets — across hub graphs, padded rows, reciprocal graphs and width
+truncation, so the fast path can be adopted with no numerical caveat.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.ops.affinities import (joint_distribution,
+                                           joint_distribution_split,
+                                           pairwise_affinities,
+                                           reverse_merge, split_width,
+                                           symmetrized_width)
+
+
+def _rows_to_dicts(jidx, jval):
+    """Row layout -> list of {neighbor: value} (valid entries only)."""
+    jidx, jval = np.asarray(jidx), np.asarray(jval)
+    out = []
+    for r in range(jidx.shape[0]):
+        m = jval[r] > 0
+        d = {}
+        for j, v in zip(jidx[r][m], jval[r][m]):
+            assert j not in d, f"duplicate neighbor {j} in row {r}"
+            d[int(j)] = float(v)
+        out.append(d)
+    return out
+
+
+def _random_knn(n, k, seed, pad_frac=0.0):
+    """Distinct per-row neighbor ids != self, with optional absent entries."""
+    rng = np.random.default_rng(seed)
+    idx = np.empty((n, k), np.int32)
+    for i in range(n):
+        choices = rng.choice(n - 1, size=k, replace=False)
+        idx[i] = np.where(choices >= i, choices + 1, choices)
+    p = rng.random((n, k)).astype(np.float64) + 1e-3
+    if pad_frac:
+        p[rng.random((n, k)) < pad_frac] = 0.0
+    p /= np.maximum(p.sum(1, keepdims=True), 1e-30)
+    return jnp.asarray(idx), jnp.asarray(p)
+
+
+@pytest.mark.parametrize("seed,pad_frac", [(0, 0.0), (1, 0.3), (2, 0.0)])
+def test_split_equals_sorted(seed, pad_frac):
+    idx, p = _random_knn(60, 7, seed, pad_frac)
+    js, vs = joint_distribution(idx, p)
+    jd, vd = joint_distribution_split(idx, p)
+    a, b = _rows_to_dicts(js, vs), _rows_to_dicts(jd, vd)
+    for r, (da, db) in enumerate(zip(a, b)):
+        assert set(da) == set(db), f"row {r} neighbor sets differ"
+        for j in da:
+            assert da[j] == pytest.approx(db[j], rel=1e-12), (r, j)
+    assert float(jnp.sum(vd)) == pytest.approx(1.0, abs=1e-9)
+
+
+def test_split_hub_graph():
+    """Everyone points at node 0: max reverse-only load on one row."""
+    n, k = 40, 4
+    rng = np.random.default_rng(3)
+    idx = np.empty((n, k), np.int32)
+    for i in range(n):  # distinct ids, never self, col 0 = the hub
+        pool = [j for j in range(1, n) if j != i]
+        idx[i] = [0 if i else 1] + list(rng.choice(pool, k - 1,
+                                                   replace=False))
+        while len(set(idx[i])) < k:  # hub may collide with a draw
+            idx[i, 1:] = rng.choice(pool, k - 1, replace=False)
+    idx = jnp.asarray(idx)
+    p = jnp.asarray(rng.random((n, k)) + 1e-3)
+    p = p / p.sum(1, keepdims=True)
+    a = _rows_to_dicts(*joint_distribution(idx, p))
+    b = _rows_to_dicts(*joint_distribution_split(idx, p))
+    assert a == b if a == b else all(
+        set(x) == set(y) and all(x[j] == pytest.approx(y[j], rel=1e-12)
+                                 for j in x) for x, y in zip(a, b))
+
+
+def test_split_reciprocal_graph():
+    """Fully mutual ring: zero reverse-only entries, width == k slots."""
+    n, k = 24, 2
+    idx = jnp.asarray([[(i - 1) % n, (i + 1) % n] for i in range(n)],
+                      jnp.int32)
+    p = jnp.full((n, k), 0.5, jnp.float64)
+    w = int(jax.jit(split_width)(idx, p))
+    assert w == k  # no reverse-only entries -> exact k, no padding waste
+    a = _rows_to_dicts(*joint_distribution(idx, p))
+    b = _rows_to_dicts(*joint_distribution_split(idx, p, sym_width=w))
+    for x, y in zip(a, b):
+        assert set(x) == set(y)
+        for j in x:
+            assert x[j] == pytest.approx(y[j], rel=1e-12)
+
+
+def test_split_width_is_exact_not_bound():
+    """split_width == the width joint_distribution_split actually needs:
+    lossless, and equal to the reported retry width.  (It is NOT always
+    narrower than symmetrized_width's out+in bound — the forward block
+    reserves k slots even for rows that are mostly padding — but on full
+    rows, where the sorted bound double-counts mutual edges, it is.)"""
+    idx, p = _random_knn(80, 6, 4, pad_frac=0.2)
+    w_split = int(jax.jit(split_width)(idx, p))
+    _, _, dropped, needed = joint_distribution_split(
+        idx, p, sym_width=w_split, return_dropped=True, return_needed=True)
+    assert int(dropped) == 0
+    assert int(needed) == w_split
+    # full rows (no padding): exact beats the double-counting bound
+    idx_f, p_f = _random_knn(80, 6, 8)
+    assert (int(jax.jit(split_width)(idx_f, p_f))
+            <= int(jax.jit(symmetrized_width)(idx_f, p_f)))
+
+
+def test_split_truncation_accounting():
+    """An explicit too-small width drops reverse-only entries, counts them,
+    reports the lossless width, and still normalizes to exactly 1."""
+    n, k = 40, 4
+    idx, p = _random_knn(n, k, 5)
+    idx = idx.at[1:, 0].set(0)  # hub row 0
+    full_w = int(jax.jit(split_width)(idx, p))
+    assert full_w > k + 8
+    jd, vd, dropped, needed = joint_distribution_split(
+        idx, p, sym_width=k + 8, return_dropped=True, return_needed=True)
+    assert int(dropped) > 0
+    assert int(needed) == full_w
+    assert float(jnp.sum(vd)) == pytest.approx(1.0, abs=1e-9)
+    assert jd.shape[1] == k + 8
+
+
+def test_split_row_deg_matches_sorted():
+    idx, p = _random_knn(50, 5, 6, pad_frac=0.25)
+    _, _, deg_s = joint_distribution(idx, p, return_row_deg=True)
+    _, _, deg_d = joint_distribution_split(idx, p, return_row_deg=True)
+    assert np.array_equal(np.asarray(deg_s), np.asarray(deg_d))
+
+
+def test_reverse_merge_chunked_equals_single_shot():
+    idx, p = _random_knn(100, 5, 7)
+    whole = reverse_merge(idx, p)
+    chunked = reverse_merge(idx, p, row_chunk=16)  # forces the lax.map path
+    assert np.allclose(np.asarray(whole), np.asarray(chunked), atol=0)
+
+
+def test_pipeline_split_self_heals_foreign_width():
+    """A sym_width sized for the SORTED layout must not silently alter P
+    when the assembly flips to split (code-review r5): affinity_pipeline
+    detects the drop and reruns at split's exact width."""
+    from tsne_flink_tpu.ops.affinities import affinity_pipeline
+    # deterministic under-sizing: row 0 keeps only 2 valid forward entries
+    # (6 padded-inf) but takes 30 non-mutual in-edges: the sorted bound
+    # rounds (2+30) up to 32 while split needs 8 + roundup8(30) = 40
+    rng = np.random.default_rng(11)
+    n, k = 60, 8
+    idx = np.empty((n, k), np.int32)
+    for i in range(n):
+        pool = [j for j in range(1, n) if j != i]
+        idx[i] = rng.choice(pool, size=k, replace=False)
+    idx[0] = [58, 59] + list(rng.choice(range(1, 58), 6, replace=False))
+    idx[1:31, 0] = 0                      # 30 in-edges to row 0
+    dist = np.sort(rng.random((n, k)), axis=1)
+    dist[0, 2:] = np.inf                  # row 0 out-degree 2
+    idx, dist = jnp.asarray(idx), jnp.asarray(dist)
+
+    p = pairwise_affinities(dist, 4.0)
+    w_sorted = int(jax.jit(symmetrized_width)(idx, p))
+    # this fixture genuinely under-sizes the split layout at sorted's width
+    _, _, dropped = joint_distribution_split(idx, p, sym_width=w_sorted,
+                                             return_dropped=True)
+    assert int(dropped) > 0, "fixture no longer exercises the heal path"
+
+    healed = _rows_to_dicts(*affinity_pipeline(
+        idx, dist, 4.0, sym_width=w_sorted, assembly="split"))
+    auto = _rows_to_dicts(*affinity_pipeline(idx, dist, 4.0,
+                                             assembly="split"))
+    for r, (x_, y_) in enumerate(zip(healed, auto)):
+        assert set(x_) == set(y_), f"row {r}"
+        for j in x_:
+            assert x_[j] == pytest.approx(y_[j], rel=1e-12)
+
+
+def test_pipeline_assembly_switch():
+    """affinity_pipeline(assembly=...) produces the same P either way from
+    real kNN input (distances, beta search and all)."""
+    from tsne_flink_tpu.ops.knn import knn
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((120, 8)).astype(np.float32))
+    idx, dist = knn(x, 10, "bruteforce")
+    p = pairwise_affinities(dist.astype(jnp.float64), 8.0)
+    a = _rows_to_dicts(*joint_distribution(idx, p))
+    b = _rows_to_dicts(*joint_distribution_split(idx, p))
+    for r, (x_, y_) in enumerate(zip(a, b)):
+        assert set(x_) == set(y_), f"row {r}"
+        for j in x_:
+            assert x_[j] == pytest.approx(y_[j], rel=1e-10)

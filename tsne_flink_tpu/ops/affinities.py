@@ -93,20 +93,206 @@ def pairwise_affinities(dist: jnp.ndarray, perplexity: float,
 
 
 def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
-                      sym_width: int | None = None):
+                      sym_width: int | None = None,
+                      assembly: str | None = None):
     """kNN distances -> symmetrized normalized P rows, fully jitted: the
-    driver-facing composition of :func:`pairwise_affinities`,
-    :func:`symmetrized_width` and :func:`joint_distribution` (eager dispatch
-    over a TPU tunnel pays a network roundtrip PER OP — measured 100x on the
-    beta search).  Returns (jidx, jval)."""
+    driver-facing composition of :func:`pairwise_affinities`, a width sizing
+    pass and the symmetrized assembly (eager dispatch over a TPU tunnel pays
+    a network roundtrip PER OP — measured 100x on the beta search).
+
+    ``assembly`` picks the layout builder: ``"sorted"`` =
+    :func:`joint_distribution` (2-key sort + scatter, rows sorted by
+    neighbor id — the golden-comparable form), ``"split"`` =
+    :func:`joint_distribution_split` (gather-merge + single-key sort, the
+    TPU-fast form; valid here because kNN rows have distinct ids).  Default
+    comes from ``TSNE_AFFINITY_ASSEMBLY`` (else ``"sorted"``) so bench/CLI
+    runs can A/B without a code change.  Returns (jidx, jval)."""
+    import os as _os
+
     import jax as _jax
     from functools import partial as _partial
 
+    if assembly is None:
+        assembly = _os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted")
+    if assembly not in ("sorted", "split"):
+        raise ValueError(f"assembly '{assembly}' not in ('sorted', 'split')")
+
     p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
+    if assembly == "split":
+        if sym_width is None:
+            w, rev = _jax.jit(_partial(split_width, return_rev=True))(
+                idx, p_cond)
+            return _jax.jit(_partial(joint_distribution_split,
+                                     sym_width=int(w)))(idx, p_cond, rev=rev)
+        # an explicit sym_width was sized for SOME layout — possibly the
+        # sorted one, whose lossless width differs from split's (the k
+        # forward slots are reserved even on padded rows).  Never silently
+        # alter P over a layout flip: check the drop count and self-heal to
+        # the exact width, mirroring the repo-wide width contract.
+        jidx, jval, dropped, needed = _jax.jit(_partial(
+            joint_distribution_split, sym_width=sym_width,
+            return_dropped=True, return_needed=True))(idx, p_cond)
+        if int(dropped) > 0:
+            import sys as _sys
+            print(f"# sym_width {sym_width} lossless for the sorted layout "
+                  f"drops {int(dropped)} entries in the split layout; "
+                  f"rerunning at its exact width {int(needed)}",
+                  file=_sys.stderr)
+            jidx, jval = _jax.jit(_partial(
+                joint_distribution_split, sym_width=int(needed)))(idx, p_cond)
+        return jidx, jval
     if sym_width is None:
         sym_width = int(_jax.jit(symmetrized_width)(idx, p_cond))
     return _jax.jit(_partial(joint_distribution, sym_width=sym_width))(
         idx, p_cond)
+
+
+def reverse_merge(idx: jnp.ndarray, p: jnp.ndarray,
+                  row_chunk: int | None = None):
+    """Per-edge transpose values WITHOUT a shuffle: for each kNN edge
+    (i, a) with neighbor j = idx[i, a], returns ``rev[i, a]`` =
+    p_{i|j} (0 when j does not list i) — a pure gather + compare + reduce
+    over [N, k, k], the TPU-friendly half of symmetrization (no sort, no
+    scatter; XLA fuses the reduction, nothing big materializes).
+
+    PRECONDITION: neighbor ids are distinct within each row (the kNN
+    contract — every producer in ops/knn.py dedups); a duplicated id would
+    double-count its transpose value.
+
+    ``row_chunk`` bounds the [chunk, k, k] working set (auto: ~2^27
+    elements); rows are processed in ``lax.map`` chunks so the peak memory
+    stays flat at any N.
+    """
+    n, k = idx.shape
+    if row_chunk is None:
+        row_chunk = int(max(256, min(n, 2 ** 27 // max(1, k * k))))
+    own = jnp.arange(n, dtype=jnp.int32)
+
+    def chunk(args):
+        idx_c, own_c = args
+        nbr = idx[idx_c]                       # [rc, k, k]
+        pj = p[idx_c]                          # [rc, k, k]
+        hit = nbr == own_c[:, None, None]
+        return jnp.sum(jnp.where(hit, pj, 0.0), axis=-1)
+
+    if n <= row_chunk:
+        return chunk((idx, own))
+    pad = (-n) % row_chunk
+    idx_p = jnp.pad(idx, ((0, pad), (0, 0)))
+    own_p = jnp.pad(own, (0, pad), constant_values=-1)  # matches no nbr
+    nc = (n + pad) // row_chunk
+    rev = lax.map(chunk, (idx_p.reshape(nc, row_chunk, k),
+                          own_p.reshape(nc, row_chunk)))
+    return rev.reshape(n + pad, k)[:n]
+
+
+def split_width(idx: jnp.ndarray, p: jnp.ndarray, return_rev: bool = False):
+    """EXACT row width the split layout needs: k forward slots + the max
+    per-row count of reverse-only entries, lane-rounded up to a multiple
+    of 8.  Jittable companion of :func:`joint_distribution_split` (compare
+    :func:`symmetrized_width`, which bounds the sorted layout's width by
+    out+in degree and so over-allocates by the mutual-edge count).  With
+    ``return_rev`` also returns the :func:`reverse_merge` values so the
+    assembly call can skip recomputing them."""
+    n, k = idx.shape
+    rev = reverse_merge(idx, p)
+    emit = (p > 0) & (rev == 0)               # reverse-only generators
+    rev_deg = jax.ops.segment_sum(
+        emit.reshape(-1).astype(jnp.int32),
+        jnp.where(emit, idx, n).reshape(-1), num_segments=n + 1)[:n]
+    c = jnp.max(rev_deg)
+    w = (k + (c + 7) // 8 * 8).astype(jnp.int32)
+    return (w, rev) if return_rev else w
+
+
+def joint_distribution_split(idx: jnp.ndarray, p: jnp.ndarray,
+                             sym_width: int | None = None,
+                             return_dropped: bool = False,
+                             return_needed: bool = False,
+                             return_row_deg: bool = False,
+                             rev: jnp.ndarray | None = None):
+    """Symmetrize + normalize like :func:`joint_distribution`, built from
+    TPU-fast primitives only (round-5 on-chip finding: the sorted
+    assembly's 2-key ``lax.sort`` over 2Nk triples + [N, S] scatter ran the
+    60k affinity stage at 94-141 s on a v5e vs 9.8 s on a 1-core CPU).
+
+    Layout per row: slots [0, k) hold the forward kNN edges with MERGED
+    values p_j|i + p_i|j computed in place by :func:`reverse_merge` (no
+    communication at all), slots [k, S) hold the reverse-only entries
+    (j lists i, i does not list j), placed by ONE single-key sort of at
+    most Nk triples + searchsorted + gather — no scatter anywhere.  Rows
+    are NOT sorted by neighbor id (nothing downstream requires it; the
+    edge-layout attraction only needs row-ascending ``src``, which the
+    row-major flatten preserves).  Padding is (idx=0, val=0) and valid
+    entries carry val >= 1e-12, so ``jval > 0`` remains the validity mask.
+
+    Same optional outputs as :func:`joint_distribution`: ``dropped`` counts
+    distinct entries lost to an explicit ``sym_width`` (reverse-only
+    entries past the row's capacity, plus forward slots past S if S < k),
+    ``needed`` is the lane-rounded width a retry needs to lose nothing,
+    ``row_deg`` the true pre-truncation distinct degree per row.
+
+    PRECONDITION (from :func:`reverse_merge`): per-row neighbor ids are
+    distinct — guaranteed by every kNN in ops/knn.py.  Use the sorted
+    :func:`joint_distribution` for arbitrary COO input.
+    """
+    n, k = idx.shape
+    dtype = p.dtype
+    present = p > 0
+    if rev is None:
+        rev = reverse_merge(idx, p)  # callers holding rev (e.g. the
+        # affinity_pipeline width pass) pass it in to skip the recompute
+    vf = jnp.where(present, p + rev, jnp.zeros((), dtype))
+
+    # reverse-only edge list: (target row t, neighbor i, value p) for each
+    # forward edge whose transpose is absent; dump key n sorts last
+    emit = present & (rev == 0)
+    t = jnp.where(emit, idx, n).reshape(-1)
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                           (n, k)).reshape(-1)
+    val = jnp.where(emit, p, jnp.zeros((), dtype)).reshape(-1)
+    t_s, src_s, val_s = lax.sort((t, src, val), num_keys=1)
+
+    bounds = jnp.searchsorted(t_s, jnp.arange(n + 1, dtype=jnp.int32))
+    starts, ends = bounds[:n], bounds[1:]
+    rev_deg = ends - starts
+    max_rev = jnp.max(rev_deg)
+    needed = (k + (max_rev + 7) // 8 * 8).astype(jnp.int32)
+
+    if sym_width is not None:
+        s = int(sym_width)
+    else:
+        s = int(needed)  # host sync; preprocessing only
+    c = max(0, s - k)
+
+    cols = jnp.arange(c, dtype=jnp.int32)
+    pos = starts[:, None] + cols                  # [n, c]
+    valid_r = pos < ends[:, None]
+    pos_c = jnp.clip(pos, 0, t_s.shape[0] - 1)
+    jidx2 = jnp.where(valid_r, src_s[pos_c], 0)
+    jval2 = jnp.where(valid_r, val_s[pos_c], jnp.zeros((), dtype))
+
+    jidx1 = jnp.where(present, idx, 0).astype(jnp.int32)
+    jidx = jnp.concatenate([jidx1, jidx2], axis=1)[:, :s]
+    jval = jnp.concatenate([vf, jval2], axis=1)[:, :s]
+
+    sum_p = jnp.sum(jval)
+    valid = jval > 0
+    jval = jnp.where(valid, jnp.maximum(jval / sum_p, P_FLOOR),
+                     jnp.zeros((), dtype))
+    jidx = jnp.where(valid, jidx, 0)
+
+    out = [jidx, jval]
+    if return_dropped:
+        dropped = jnp.sum(jnp.maximum(rev_deg - c, 0))
+        if s < k:  # forward slots past S are sliced off above
+            dropped = dropped + jnp.sum(present[:, s:])
+        out.append(dropped)
+    if return_needed:
+        out.append(needed)
+    if return_row_deg:
+        out.append((jnp.sum(present, axis=1) + rev_deg).astype(jnp.int32))
+    return tuple(out)
 
 
 def symmetrized_width(idx: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
