@@ -210,6 +210,15 @@ def main():
     if repulsion not in REPULSION_CHOICES:
         raise SystemExit(f"repulsion arg '{repulsion}' not defined "
                          f"({' | '.join(REPULSION_CHOICES)})")
+    assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted")
+    if assembly not in ("sorted", "split", "blocks"):
+        # same fail-fast contract as the args above
+        raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
+                         "(sorted | split | blocks)")
+    if assembly == "blocks" and jax.device_count() != 1:
+        raise SystemExit("TSNE_AFFINITY_ASSEMBLY=blocks is single-device "
+                         "for now (ShardedOptimizer declines multi-device "
+                         "split-blocks); unset it or run on one device")
     # defaulted CLI theta (Tsne.scala:59 / cli.py); 0.5 only for an explicit
     # bh run — that is BASELINE config 2 verbatim (its theta IS the BH knob)
     theta = 0.5 if repulsion == "bh" else 0.25
@@ -286,7 +295,20 @@ def main():
                  "knn measured; affinities+optimize scaled by knn FLOP rate")
 
     t1 = time.time()
-    jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
+    # assembly (validated at startup): sorted | split (A/B of the [N, S]
+    # builders, ops/affinities.affinity_pipeline) | blocks (edge-direct
+    # split: never materializes [N, S] — the 1M-on-one-chip memory path)
+    extra = None
+    if assembly == "blocks":
+        from tsne_flink_tpu.ops.affinities import (pairwise_affinities,
+                                                   symmetrize_split_blocks)
+        p_cond = jax.jit(pairwise_affinities, static_argnums=1)(
+            dist, cfg.perplexity)
+        fwd_val, rsrc, rdst, rval = jax.jit(symmetrize_split_blocks)(
+            idx, p_cond)
+        jidx, jval, extra = idx, fwd_val, (rsrc, rdst, rval)
+    else:
+        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
     jval.block_until_ready()
     t_aff = time.time() - t1
 
@@ -297,8 +319,12 @@ def main():
     # FLOP model counts the launched pairs (utils/flops.py) — single- AND
     # multi-device (the decision lives in ONE place: affinities.plan_edges
     # via ShardedOptimizer.attraction_plan)
-    layout, pairs, _ = runner.attraction_plan(jidx, jval)
-    use_edges = layout == "edges"
+    if assembly == "blocks":
+        layout, pairs = "blocks", n * s + int(rsrc.shape[0])
+        use_edges = True  # pair-count-based FLOP model, like edges
+    else:
+        layout, pairs, _ = runner.attraction_plan(jidx, jval)
+        use_edges = layout == "edges"
     f_opt = optimize_flops(n, s, 2, iters, repulsion,
                            nnz_pairs=pairs if use_edges else None,
                            theta=cfg.theta,  # bh auto-frontier mirror
@@ -342,7 +368,7 @@ def main():
 
     try:
         state, losses = runner(state, jidx, jval, checkpoint_every=seg,
-                               checkpoint_cb=cb)
+                               checkpoint_cb=cb, extra_edges=extra)
         it_done = iters
     except _DeadlineStop:
         state, losses = prog["state"], prog["losses"]

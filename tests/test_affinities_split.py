@@ -185,6 +185,53 @@ def test_pipeline_split_self_heals_foreign_width():
             assert x_[j] == pytest.approx(y_[j], rel=1e-12)
 
 
+def test_blocks_encode_same_p_and_sum_to_one():
+    from tsne_flink_tpu.ops.affinities import symmetrize_split_blocks
+    idx, p = _random_knn(60, 7, 9, pad_frac=0.2)
+    fwd_val, rsrc, rdst, rval = jax.jit(symmetrize_split_blocks)(idx, p)
+    total = float(jnp.sum(fwd_val) + jnp.sum(rval))
+    assert total == pytest.approx(1.0, abs=1e-9)
+    # rebuild each row's {neighbor: value} view from the two blocks and
+    # compare against the [N, S] layout
+    rows = _rows_to_dicts(idx, fwd_val)
+    rs, rd, rv = np.asarray(rsrc), np.asarray(rdst), np.asarray(rval)
+    assert (np.diff(rs) >= 0).all()  # sorted incl. dump tail (segment_sum)
+    for s_, d_, v_ in zip(rs, rd, rv):
+        if v_ > 0:
+            assert d_ not in rows[s_]
+            rows[s_][int(d_)] = float(v_)
+    ref = _rows_to_dicts(*joint_distribution(idx, p))
+    for r, (x_, y_) in enumerate(zip(ref, rows)):
+        assert set(x_) == set(y_), f"row {r}"
+        for j in x_:
+            assert x_[j] == pytest.approx(y_[j], rel=1e-12)
+
+
+def test_blocks_gradient_matches_row_layout():
+    """One optimize step via (forward rows + reverse edges, edges_extra)
+    == one step via the [N, S] layout: same forces, same loss."""
+    from tsne_flink_tpu.models.tsne import (TsneConfig, init_working_set,
+                                            optimize)
+    from tsne_flink_tpu.ops.affinities import symmetrize_split_blocks
+    idx, p = _random_knn(80, 6, 10, pad_frac=0.15)
+    js, vs = joint_distribution(idx, p)
+    fwd_val, rsrc, rdst, rval = symmetrize_split_blocks(idx, p)
+
+    cfg = TsneConfig(iterations=10, repulsion="exact", exact_impl="xla")
+    st0 = init_working_set(jax.random.key(2), 80, 2, jnp.float64)
+    for iters in (1, 10):
+        y_rows, loss_rows = optimize(st0, js, vs, cfg, num_iters=iters)
+        y_blk, loss_blk = optimize(st0, idx, fwd_val, cfg, num_iters=iters,
+                                   edges=(rsrc, rdst, rval),
+                                   edges_extra=True)
+        np.testing.assert_allclose(np.asarray(y_blk.y),
+                                   np.asarray(y_rows.y),
+                                   rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(loss_blk),
+                                   np.asarray(loss_rows),
+                                   rtol=1e-9, atol=1e-12)
+
+
 def test_pipeline_assembly_switch():
     """affinity_pipeline(assembly=...) produces the same P either way from
     real kNN input (distances, beta search and all)."""

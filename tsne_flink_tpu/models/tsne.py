@@ -182,7 +182,8 @@ def _attractive_forces_edges(y_local, y_full, src, dst, val, exag, z):
 
 
 def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
-              axis_name=None, row_offset=0, valid_full=None, edges=None):
+              axis_name=None, row_offset=0, valid_full=None, edges=None,
+              edges_extra=False):
     """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317).
 
     ``valid_full`` is the GLOBAL point-validity mask (already gathered once,
@@ -220,7 +221,16 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
     else:
         raise ValueError(f"unknown repulsion backend '{cfg.repulsion}'")
     z = _psum(sq, axis_name)
-    if edges is not None:
+    if edges is not None and edges_extra:
+        # split-blocks layout (affinities.symmetrize_split_blocks): the
+        # rows part is the width-k forward block with merged values, the
+        # edges part the reverse-only entries — attraction is their sum
+        att, loss = _attractive_forces(y_local, y_full, jidx, jval,
+                                       exag, z, row_chunk=cfg.row_chunk)
+        att_r, loss_r = _attractive_forces_edges(y_local, y_full, *edges,
+                                                 exag, z)
+        att, loss = att + att_r, loss + loss_r
+    elif edges is not None:
         att, loss = _attractive_forces_edges(y_local, y_full, *edges,
                                              exag, z)
     else:
@@ -269,7 +279,7 @@ def center_input(x: jnp.ndarray, axis_name=None, valid=None) -> jnp.ndarray:
 def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
              axis_name=None, row_offset=0, valid=None,
              start_iter=0, num_iters: int | None = None,
-             loss_carry=None, edges=None):
+             loss_carry=None, edges=None, edges_extra=False):
     """Full 3-phase gradient descent as ONE compiled fori_loop.
 
     Returns (final TsneState, loss trace [iterations // 10]); trace slot t is
@@ -299,7 +309,8 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
         exag = jnp.where(i < cfg.exaggeration_end, alpha, one)
         grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
                                axis_name=axis_name, row_offset=row_offset,
-                               valid_full=valid_full, edges=edges)
+                               valid_full=valid_full, edges=edges,
+                               edges_extra=edges_extra)
         if valid is not None:
             grad = grad * valid[:, None].astype(grad.dtype)
         st = _update_embedding(st, grad, momentum, cfg)

@@ -128,10 +128,13 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
         # sorted one, whose lossless width differs from split's (the k
         # forward slots are reserved even on padded rows).  Never silently
         # alter P over a layout flip: check the drop count and self-heal to
-        # the exact width, mirroring the repo-wide width contract.
+        # the exact width, mirroring the repo-wide width contract.  rev is
+        # computed ONCE and reused by probe and retry (it is the most
+        # expensive primitive in the preprocessing path).
+        rev = _jax.jit(reverse_merge)(idx, p_cond)
         jidx, jval, dropped, needed = _jax.jit(_partial(
             joint_distribution_split, sym_width=sym_width,
-            return_dropped=True, return_needed=True))(idx, p_cond)
+            return_dropped=True, return_needed=True))(idx, p_cond, rev=rev)
         if int(dropped) > 0:
             import sys as _sys
             print(f"# sym_width {sym_width} lossless for the sorted layout "
@@ -139,7 +142,8 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
                   f"rerunning at its exact width {int(needed)}",
                   file=_sys.stderr)
             jidx, jval = _jax.jit(_partial(
-                joint_distribution_split, sym_width=int(needed)))(idx, p_cond)
+                joint_distribution_split,
+                sym_width=int(needed)))(idx, p_cond, rev=rev)
         return jidx, jval
     if sym_width is None:
         sym_width = int(_jax.jit(symmetrized_width)(idx, p_cond))
@@ -184,6 +188,27 @@ def reverse_merge(idx: jnp.ndarray, p: jnp.ndarray,
     rev = lax.map(chunk, (idx_p.reshape(nc, row_chunk, k),
                           own_p.reshape(nc, row_chunk)))
     return rev.reshape(n + pad, k)[:n]
+
+
+def _split_edge_parts(idx: jnp.ndarray, p: jnp.ndarray,
+                      rev: jnp.ndarray | None = None):
+    """Shared core of the two split builders: merged forward values plus
+    the reverse-only edge list (target row, neighbor, value) sorted by
+    target ascending with dump entries (key n, val 0) last.  Returns
+    ``(present, vf, t_sorted, src_sorted, val_sorted)``."""
+    n, k = idx.shape
+    dtype = p.dtype
+    present = p > 0
+    if rev is None:
+        rev = reverse_merge(idx, p)  # callers holding rev pass it in
+    vf = jnp.where(present, p + rev, jnp.zeros((), dtype))
+    emit = present & (rev == 0)
+    t = jnp.where(emit, idx, n).reshape(-1)
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                           (n, k)).reshape(-1)
+    val = jnp.where(emit, p, jnp.zeros((), dtype)).reshape(-1)
+    t_s, src_s, val_s = lax.sort((t, src, val), num_keys=1)
+    return present, vf, t_s, src_s, val_s
 
 
 def split_width(idx: jnp.ndarray, p: jnp.ndarray, return_rev: bool = False):
@@ -238,20 +263,7 @@ def joint_distribution_split(idx: jnp.ndarray, p: jnp.ndarray,
     """
     n, k = idx.shape
     dtype = p.dtype
-    present = p > 0
-    if rev is None:
-        rev = reverse_merge(idx, p)  # callers holding rev (e.g. the
-        # affinity_pipeline width pass) pass it in to skip the recompute
-    vf = jnp.where(present, p + rev, jnp.zeros((), dtype))
-
-    # reverse-only edge list: (target row t, neighbor i, value p) for each
-    # forward edge whose transpose is absent; dump key n sorts last
-    emit = present & (rev == 0)
-    t = jnp.where(emit, idx, n).reshape(-1)
-    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
-                           (n, k)).reshape(-1)
-    val = jnp.where(emit, p, jnp.zeros((), dtype)).reshape(-1)
-    t_s, src_s, val_s = lax.sort((t, src, val), num_keys=1)
+    present, vf, t_s, src_s, val_s = _split_edge_parts(idx, p, rev)
 
     bounds = jnp.searchsorted(t_s, jnp.arange(n + 1, dtype=jnp.int32))
     starts, ends = bounds[:n], bounds[1:]
@@ -293,6 +305,49 @@ def joint_distribution_split(idx: jnp.ndarray, p: jnp.ndarray,
     if return_row_deg:
         out.append((jnp.sum(present, axis=1) + rev_deg).astype(jnp.int32))
     return tuple(out)
+
+
+def symmetrize_split_blocks(idx: jnp.ndarray, p: jnp.ndarray,
+                            rev: jnp.ndarray | None = None):
+    """Edge-direct symmetrization: the joint P as TWO static blocks, never
+    materializing the [N, S] padded row layout (at 1M points a hub-widened
+    S puts jidx+jval alone past a v5e's 16 GB HBM — the round-5 on-chip 1M
+    blocker; these blocks total ~3 Nk words regardless of hubs).
+
+    Returns ``(fwd_val [N, k], rev_src [Nk], rev_dst [Nk], rev_val [Nk])``:
+
+    * Forward block — row layout of width k with ``idx`` itself as the
+      structure: ``fwd_val[i, a]`` is the MERGED value p_j|i + p_i|j for
+      j = idx[i, a] (0 where absent), computed in place by
+      :func:`reverse_merge`.  Feed (idx, fwd_val) anywhere a (jidx, jval)
+      row layout is accepted — it is one, with zero hub padding.
+    * Reverse block — the reverse-only entries (j lists i, i does not
+      list j) as an edge list INTO ``rev_src``, sorted ascending by
+      ``rev_src`` including the dump tail (src = n-1, dst = 0, val = 0),
+      so ``segment_sum(..., indices_are_sorted=True)`` is valid — the
+      same contract as :func:`assemble_edges`.  Mask by ``val > 0``.
+
+    Values are globally normalized (Σ over both blocks == 1) and floored
+    at ``P_FLOOR`` exactly like :func:`joint_distribution`; every distinct
+    symmetrized entry appears in each endpoint's view exactly once
+    (forward slot on the listing side, reverse slot on the listed side),
+    so row sums, forces and the KL accounting match the [N, S] layout.
+    Fully static shapes — no width contract, no truncation, no host sync.
+
+    PRECONDITION (from :func:`reverse_merge`): distinct per-row ids.
+    """
+    n, k = idx.shape
+    dtype = p.dtype
+    present, vf, t_s, dst_s, val_s = _split_edge_parts(idx, p, rev)
+    rev_src = jnp.minimum(t_s, n - 1).astype(jnp.int32)  # dump tail n -> n-1
+    rev_dst = jnp.where(val_s > 0, dst_s, 0).astype(jnp.int32)
+
+    sum_p = jnp.sum(vf) + jnp.sum(val_s)
+    vf = jnp.where(present, jnp.maximum(vf / sum_p, P_FLOOR),
+                   jnp.zeros((), dtype))
+    rev_val = jnp.where(val_s > 0, jnp.maximum(val_s / sum_p, P_FLOOR),
+                        jnp.zeros((), dtype))
+    return vf, rev_src, rev_dst, rev_val
 
 
 def symmetrized_width(idx: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:

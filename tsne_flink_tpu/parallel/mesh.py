@@ -79,18 +79,22 @@ class ShardedOptimizer:
         self._fns = {}  # num_iters (static) -> compiled segment runner
 
     def _segment_fn(self, num_iters: int, with_edges: bool = False,
-                    trace_edge_pad: int | None = None):
+                    trace_edge_pad: int | None = None,
+                    edges_extra: bool = False):
         """``with_edges``: host-prebuilt edge arrays ride as extra inputs.
         ``trace_edge_pad``: the edge conversion instead runs IN-TRACE on each
         shard's local rows (static pad per shard) — the only form available
         to multi-controller runs, whose hosts cannot slice the
-        non-addressable global rows (VERDICT r3 weak #2)."""
-        key = (num_iters, with_edges, trace_edge_pad)
+        non-addressable global rows (VERDICT r3 weak #2).  ``edges_extra``:
+        the split-blocks layout (jidx/jval are the width-k forward block,
+        the edge arrays the reverse-only block; attraction sums both)."""
+        key = (num_iters, with_edges, trace_edge_pad, edges_extra)
         if key in self._fns:
             return self._fns[key]
         cfg_ = self.cfg
         if self.n_devices == 1:
-            fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters))
+            fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters,
+                                 edges_extra=edges_extra))
         else:
             n_local = self.n_local
 
@@ -221,7 +225,7 @@ class ShardedOptimizer:
     def __call__(self, state: TsneState, jidx, jval, *, start_iter: int = 0,
                  loss_carry=None, checkpoint_every: int = 0,
                  checkpoint_cb=None, pre_padded_valid=None, unpad: bool = True,
-                 edge_pad: int | None = None):
+                 edge_pad: int | None = None, extra_edges=None):
         """Run iterations [start_iter, cfg.iterations); if checkpointing,
         ``checkpoint_cb(state, next_iter, losses)`` fires every
         ``checkpoint_every`` iterations with the UNPADDED state.
@@ -236,7 +240,18 @@ class ShardedOptimizer:
         the flat edge attraction layout IN-TRACE on each shard — the
         host-side conversion below is impossible there (VERDICT r3 weak #2;
         same gate/threshold as every other path, ops/affinities
-        .edges_beneficial)."""
+        .edges_beneficial).  ``extra_edges`` (single-device only) is the
+        reverse-only block of the split-blocks layout
+        (ops/affinities.symmetrize_split_blocks): jidx/jval must then be
+        the width-k forward block and attraction sums both — the
+        memory-flat path that never builds [N, S] (round-5 1M-on-one-chip
+        HBM fix)."""
+        if extra_edges is not None and self.n_devices != 1:
+            raise NotImplementedError(
+                "split-blocks attraction is single-device for now: the "
+                "reverse block's src rows are global and would need "
+                "routing to shards — use the rows/alltoall SPMD path on "
+                "multi-device meshes")
         if pre_padded_valid is not None:
             valid = pre_padded_valid
         elif self.n_devices == 1:
@@ -272,6 +287,8 @@ class ShardedOptimizer:
                       "edge_pad in multi-controller runs (none given, or the "
                       "per-shard conversion would overflow int32 slots); "
                       "running the rows layout", file=sys.stderr)
+        elif extra_edges is not None:
+            edges = tuple(extra_edges)
         else:
             edges = self._build_edges(jidx, jval)
         total = self.cfg.iterations
@@ -283,7 +300,8 @@ class ShardedOptimizer:
             if step <= 0:
                 break
             fn = self._segment_fn(step, with_edges=edges is not None,
-                                  trace_edge_pad=trace_pad)
+                                  trace_edge_pad=trace_pad,
+                                  edges_extra=extra_edges is not None)
             state, losses = self._run_segment(fn, state, jidx, jval, valid,
                                               it, losses, edges)
             it += step
