@@ -300,15 +300,11 @@ def main():
     # split: never materializes [N, S] — the 1M-on-one-chip memory path)
     extra = None
     if assembly == "blocks":
-        from tsne_flink_tpu.ops.affinities import (pairwise_affinities,
-                                                   symmetrize_split_blocks)
-        p_cond = jax.jit(pairwise_affinities, static_argnums=1)(
-            dist, cfg.perplexity)
-        fwd_val, rsrc, rdst, rval = jax.jit(symmetrize_split_blocks)(
-            idx, p_cond)
-        jidx, jval, extra = idx, fwd_val, (rsrc, rdst, rval)
+        from tsne_flink_tpu.ops.affinities import affinity_blocks
+        jidx, jval, extra = affinity_blocks(idx, dist, cfg.perplexity)
     else:
-        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
+        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity,
+                                       assembly=assembly)
     jval.block_until_ready()
     t_aff = time.time() - t1
 
@@ -320,7 +316,7 @@ def main():
     # multi-device (the decision lives in ONE place: affinities.plan_edges
     # via ShardedOptimizer.attraction_plan)
     if assembly == "blocks":
-        layout, pairs = "blocks", n * s + int(rsrc.shape[0])
+        layout, pairs = "blocks", n * s + int(extra[0].shape[0])
         use_edges = True  # pair-count-based FLOP model, like edges
     else:
         layout, pairs, _ = runner.attraction_plan(jidx, jval)

@@ -147,8 +147,13 @@ def main():
         # crash mid-suite must not discard completed evidence
         results.append((name, n, dt, out, rss))
         tag = name.split()[0]
+        # accelerator runs get their own suffix: an on-chip pass must
+        # never overwrite the committed CPU-backend record (round 5:
+        # config 1's TPU run clobbered the CPU evidence)
+        suffix = "" if "backend=cpu" in out else "_tpu"
         with open(os.path.join(
-                "results", f"baseline_{tag}_scale{s:g}.json"), "w") as f:
+                "results", f"baseline_{tag}_scale{s:g}{suffix}.json"),
+                "w") as f:
             json.dump({"config": name, "n": n, "scale": s,
                        "wall_seconds": round(dt, 1),
                        "peak_rss_bytes": rss, "last_line": out}, f)

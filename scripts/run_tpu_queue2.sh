@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 POST-FIRST-CONTACT on-chip queue (supersedes run_tpu_queue.sh's
+# ordering once its first pass ran).  Differences learned from the first
+# contact (docs/TPU_STATUS.md "FIRST CONTACT"):
+#   * the tunnel wedges after a TPU worker crash and recovers minutes
+#     later -> every step is gated on a fresh probe, and a dead tunnel
+#     SKIPS forward (logged) instead of hanging the window;
+#   * the affinity stage is the on-chip bottleneck -> profile it first
+#     and A/B the three assemblies at the bench shape;
+#   * 1M needs the memory-flat blocks path (TSNE_AFFINITY_ASSEMBLY=blocks);
+#   * BASELINE configs 2/3 re-run on-chip (config 2's first attempt died
+#     to a device crash); config 4 uses the pre-generated
+#     .bench_inputs/c4.csv when present (generation is outside the
+#     measured workload and must never share the chip with it).
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p .tpu_queue
+Q=.tpu_queue
+export TSNE_BENCH_INIT_TIMEOUT=240 TSNE_BENCH_INIT_RETRIES=2
+
+step() {
+  local name=$1; shift
+  if ! bash scripts/tpu_probe.sh 180 >> $Q/queue2.log 2>&1; then
+    echo "=== $name SKIPPED (tunnel dead) [$(date +%H:%M:%S)]" | tee -a $Q/queue2.log
+    return 1
+  fi
+  echo "=== $name: $* [$(date +%H:%M:%S)]" | tee -a $Q/queue2.log
+  TSNE_BENCH_DEADLINE_S=$((STEP_TIMEOUT - 100)) \
+    timeout "$STEP_TIMEOUT" "$@" > "$Q/$name.log" 2>&1
+  echo "=== $name rc=$? [$(date +%H:%M:%S)]" | tee -a $Q/queue2.log
+}
+
+# 1. attribute the on-chip affinity inversion + all three assemblies
+STEP_TIMEOUT=1800 step profile_affinities python scripts/profile_affinities.py 60000 90 3
+# 2. assembly A/B at the headline shape (sorted already measured 4x)
+STEP_TIMEOUT=1500 step bench_60k_split env TSNE_AFFINITY_ASSEMBLY=split python bench.py 60000 300 fft
+STEP_TIMEOUT=1500 step bench_60k_blocks env TSNE_AFFINITY_ASSEMBLY=blocks python bench.py 60000 300 fft
+# 2b. exact repulsion with the best-so-far assembly: the 60k frontrunner
+STEP_TIMEOUT=1500 step bench_60k_exact_blocks env TSNE_AFFINITY_ASSEMBLY=blocks python bench.py 60000 300 exact
+# 3. the 1M north star on the memory-flat path
+STEP_TIMEOUT=2400 step bench_1m_blocks env TSNE_AFFINITY_ASSEMBLY=blocks python bench.py 1000000 300 fft
+# 4. BASELINE configs on-chip: 2 and 3 via the runner (fresh inputs)
+STEP_TIMEOUT=2400 step baseline_c2 python scripts/run_baseline_configs.py --scale 1 --configs 2
+STEP_TIMEOUT=2400 step baseline_c3 python scripts/run_baseline_configs.py --scale 1 --configs 3
+# 4b. config 4 from the pre-generated 400k k=90 graph (CLI direct)
+if [ -f .bench_inputs/c4.csv ]; then
+  STEP_TIMEOUT=2400 step baseline_c4 python -m tsne_flink_tpu.utils.cli \
+    --input .bench_inputs/c4.csv --output /tmp/c4_out.csv --dimension 100 \
+    --knnMethod bruteforce --inputDistanceMatrix --neighbors 90 \
+    --perplexity 30 --iterations 300
+fi
+# 5. the rest of the first queue's evidence items
+STEP_TIMEOUT=1800 step bh_100k python scripts/measure_bh_error.py 100000
+STEP_TIMEOUT=1800 step bh_100k_3d python scripts/measure_bh_error.py 100000 --dims 3 --auto
+STEP_TIMEOUT=1200 step profile_60k python scripts/profile_stages.py 60000 50 fft
+STEP_TIMEOUT=3600 step quality_60k env TSNE_QUALITY_BACKEND=tpu python scripts/quality_60k.py
+echo "=== queue2 complete [$(date +%H:%M:%S)]" | tee -a $Q/queue2.log

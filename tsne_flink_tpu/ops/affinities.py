@@ -114,8 +114,21 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
 
     if assembly is None:
         assembly = _os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted")
+        if assembly == "blocks":
+            # blocks is an edge-direct layout with a different return shape
+            # (see affinity_blocks); row-layout consumers reading the env
+            # get split — the SAME P, TPU-fast, in the shape they expect —
+            # instead of a crash in every tool that isn't bench/CLI
+            import sys as _sys
+            print("# TSNE_AFFINITY_ASSEMBLY=blocks: this caller needs the "
+                  "[N, S] row layout; using the equivalent 'split' builder",
+                  file=_sys.stderr)
+            assembly = "split"
     if assembly not in ("sorted", "split"):
-        raise ValueError(f"assembly '{assembly}' not in ('sorted', 'split')")
+        raise ValueError(
+            f"assembly '{assembly}' not in ('sorted', 'split'); for the "
+            "edge-direct blocks layout call affinity_blocks, which returns "
+            "(jidx, jval, extra_edges)")
 
     p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
     if assembly == "split":
@@ -305,6 +318,21 @@ def joint_distribution_split(idx: jnp.ndarray, p: jnp.ndarray,
     if return_row_deg:
         out.append((jnp.sum(present, axis=1) + rev_deg).astype(jnp.int32))
     return tuple(out)
+
+
+def affinity_blocks(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float):
+    """kNN distances -> the edge-direct blocks layout, fully jitted: the
+    driver-facing composition for ``assembly='blocks'`` (bench.py and the
+    CLI share THIS, so the recipe cannot diverge).  Returns
+    ``(jidx, jval, extra_edges)`` where (jidx, jval) is the width-k
+    forward row block (jidx IS the kNN structure) and ``extra_edges`` the
+    reverse-only block for ``optimize(..., edges=extra_edges,
+    edges_extra=True)`` / ``ShardedOptimizer(extra_edges=...)``."""
+    import jax as _jax
+
+    p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
+    fwd_val, rsrc, rdst, rval = _jax.jit(symmetrize_split_blocks)(idx, p_cond)
+    return idx, fwd_val, (rsrc, rdst, rval)
 
 
 def symmetrize_split_blocks(idx: jnp.ndarray, p: jnp.ndarray,

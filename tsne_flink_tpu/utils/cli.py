@@ -74,6 +74,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attraction layout: padded [N,S] rows or the flat "
                         "edge list sized by the true edge count (auto: edges "
                         "when hub rows make S >= 2x the mean degree)")
+    p.add_argument("--affinityAssembly", default=None,
+                   choices=["sorted", "split", "blocks"],
+                   help="symmetrized-P builder: sorted = 2-key sort + "
+                        "scatter into [N,S] rows (golden-comparable), "
+                        "split = gather-merge + 1-key sort into the same "
+                        "[N,S] (TPU-fast), blocks = edge-direct split that "
+                        "never materializes [N,S] (memory-flat; the "
+                        "1M-on-one-chip path; single-device, not with "
+                        "--spmd/--executionPlan).  Default: "
+                        "$TSNE_AFFINITY_ASSEMBLY or sorted")
     p.add_argument("--bhGate", default="vdm", choices=["vdm", "flink"],
                    help="BH acceptance test: vdm = side/sqrt(D) < theta "
                         "(scale-free, accurate); flink = the reference's "
@@ -327,6 +337,24 @@ def _main(argv=None) -> int:
         bh_gate=args.bhGate,
     )
 
+    # resolve the assembly BEFORE any expensive stage: blocks is
+    # single-device and has no lowered-plan form — fail in milliseconds,
+    # not after the kNN stage (code-review r5)
+    assembly = (args.affinityAssembly
+                or os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted"))
+    if assembly == "blocks":
+        if args.spmd:
+            raise SystemExit("--affinityAssembly blocks is single-device; "
+                             "the --spmd pipeline symmetrizes with its own "
+                             "replicated/alltoall strategies (--symMode)")
+        if args.executionPlan:
+            raise SystemExit("--affinityAssembly blocks does not lower an "
+                             "execution plan; use sorted or split for "
+                             "--executionPlan")
+        if (args.devices or jax.device_count()) != 1:
+            raise SystemExit("--affinityAssembly blocks is single-device "
+                             "for now; pass --devices 1 or drop the flag")
+
     if args.spmd:
         # the whole job as ONE sharded program (SpmdPipeline); with
         # --checkpoint/--resume it switches to the segmented prepare+optimize
@@ -395,7 +423,13 @@ def _main(argv=None) -> int:
               f"{pipe.n_devices} device(s), backend={jax.default_backend()})")
         return 0
 
-    jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity)
+    extra_edges = None
+    if assembly == "blocks":
+        from tsne_flink_tpu.ops.affinities import affinity_blocks
+        jidx, jval, extra_edges = affinity_blocks(idx, dist, cfg.perplexity)
+    else:
+        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity,
+                                       assembly=assembly)
 
     start_iter, loss_carry, state = _load_resume(args, dtype)
     if state is None:
@@ -423,7 +457,8 @@ def _main(argv=None) -> int:
     state, losses = runner(state, jidx, jval, start_iter=start_iter,
                            loss_carry=loss_carry,
                            checkpoint_every=args.checkpointEvery,
-                           checkpoint_cb=_make_checkpoint_cb(args))
+                           checkpoint_cb=_make_checkpoint_cb(args),
+                           extra_edges=extra_edges)
     state.y.block_until_ready()
     if args.profile:
         jax.profiler.stop_trace()
