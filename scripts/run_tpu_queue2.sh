@@ -48,6 +48,16 @@ if [ -f .bench_inputs/c4.csv ]; then
     --knnMethod bruteforce --inputDistanceMatrix --neighbors 90 \
     --perplexity 30 --iterations 300
 fi
+# 4c. config 5's 1.3M workload, single-device on the memory-flat blocks
+# path (the --spmd form cannot compile over this tunnel — shard_map hits
+# the remote AOT compile's HTTP 500; the record is labeled single-device)
+if [ -f .bench_inputs/c5.csv ]; then
+  STEP_TIMEOUT=3000 step baseline_c5 env TSNE_AFFINITY_ASSEMBLY=blocks \
+    python -m tsne_flink_tpu.utils.cli \
+    --input .bench_inputs/c5.csv --output /tmp/c5_out.csv --dimension 32 \
+    --knnMethod project --perplexity 50 --iterations 60 \
+    --affinityAssembly blocks
+fi
 # 5. the rest of the first queue's evidence items
 STEP_TIMEOUT=1800 step bh_100k python scripts/measure_bh_error.py 100000
 STEP_TIMEOUT=1800 step bh_100k_3d python scripts/measure_bh_error.py 100000 --dims 3 --auto

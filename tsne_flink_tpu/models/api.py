@@ -34,7 +34,8 @@ class TSNE:
                  knn_refine: int | None = None, random_state: int = 0,
                  spmd: bool = False, devices: int | None = None,
                  sym_mode: str = "replicated", attraction: str = "auto",
-                 dtype: str | None = None):
+                 dtype: str | None = None,
+                 affinity_assembly: str | None = None):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -72,6 +73,17 @@ class TSNE:
             raise ValueError(f"repulsion '{repulsion}' not defined "
                              f"({' | '.join(REPULSION_CHOICES)})")
         self.attraction = attraction
+        if affinity_assembly not in (None, "sorted", "split", "blocks"):
+            raise ValueError(f"affinity_assembly '{affinity_assembly}' not "
+                             "defined (sorted | split | blocks)")
+        if affinity_assembly is not None and spmd:
+            # NOT silently ignored: the spmd pipeline symmetrizes with its
+            # own replicated/alltoall strategies, so any assembly override
+            # would be dropped on the floor — refuse instead
+            raise ValueError(f"affinity_assembly='{affinity_assembly}' has "
+                             "no effect with spmd=True (symmetrization is "
+                             "chosen by sym_mode there); leave it None")
+        self.affinity_assembly = affinity_assembly
         # compute dtype for the whole pipeline (the CLI's --dtype): None
         # keeps the input's dtype; "bfloat16" is the MXU-native 2x path
         self.dtype = dtype
@@ -144,7 +156,8 @@ class TSNE:
                 x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
                 knn_blocks=self.knn_blocks,
                 knn_iterations=self.knn_iterations,
-                knn_refine=self.knn_refine, seed=self.random_state)
+                knn_refine=self.knn_refine, seed=self.random_state,
+                affinity_assembly=self.affinity_assembly)
         self.embedding_ = np.asarray(y)
         self.kl_trace_ = np.asarray(losses)
         self.kl_divergence_ = (float(self.kl_trace_[-1])

@@ -333,10 +333,15 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
                neighbors: int | None = None, knn_method: str = "bruteforce",
                knn_iterations: int | None = None, knn_refine: int | None = None,
                knn_blocks: int = 8,
-               seed: int = 0, sym_width: int | None = None):
+               seed: int = 0, sym_width: int | None = None,
+               affinity_assembly: str | None = None):
     """Single-device end-to-end pipeline (the ``computeEmbedding`` analog,
     Tsne.scala:105-136): kNN -> β-calibrated affinities -> symmetrized P ->
-    init -> optimize.  Returns (embedding [N, m], loss trace)."""
+    init -> optimize.  Returns (embedding [N, m], loss trace).
+
+    ``affinity_assembly``: sorted | split ([N, S] builders) | blocks (the
+    edge-direct memory-flat layout — at 1M points the hub-widened [N, S]
+    alone exceeds a v5e's HBM).  Default follows TSNE_AFFINITY_ASSEMBLY."""
     cfg = cfg or TsneConfig()
     n = x.shape[0]
     k = neighbors if neighbors is not None else 3 * int(cfg.perplexity)
@@ -345,13 +350,28 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
     idx, dist = jax.jit(lambda xx: knn_dispatch(
         xx, k, knn_method, cfg.metric, blocks=knn_blocks,
         rounds=knn_iterations, refine=knn_refine, key=kkey))(x)
-    jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width)
     state = init_working_set(ikey, n, cfg.n_components, x.dtype)
+    if affinity_assembly is None:
+        # the docstring's promise: the env default reaches THIS branch too,
+        # so TSNE_AFFINITY_ASSEMBLY=blocks gets the real blocks path here
+        # (tsne_embed supports it) instead of affinity_pipeline's
+        # row-layout demotion
+        import os
+        affinity_assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY")
+    if affinity_assembly == "blocks":
+        from tsne_flink_tpu.ops.affinities import affinity_blocks
+        jidx, jval, extra = affinity_blocks(idx, dist, cfg.perplexity)
+        # edges_extra must be STATIC (a python-level branch in _gradient)
+        run_blocks = jax.jit(partial(optimize, cfg=cfg, edges_extra=True))
+        state, losses = run_blocks(state, jidx, jval, edges=extra)
+        return state.y, losses
+    run = jax.jit(partial(optimize, cfg=cfg))
+    jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width,
+                                   assembly=affinity_assembly)
     edges = None
     from tsne_flink_tpu.ops.affinities import assemble_edges, plan_edges
     use_edges, e_pad = plan_edges(jidx, jval, cfg.attraction)
     if use_edges:
         edges = jax.jit(partial(assemble_edges, e_pad=e_pad))(jidx, jval)
-    run = jax.jit(partial(optimize, cfg=cfg))
     state, losses = run(state, jidx, jval, edges=edges)
     return state.y, losses

@@ -274,6 +274,27 @@ def _main(argv=None) -> int:
     from tsne_flink_tpu.utils import io as tio
     from tsne_flink_tpu.parallel.mesh import shard_pipeline
 
+    # resolve the assembly BEFORE the input parse and kNN stages: an
+    # unsupported combination (or an env typo) must fail in milliseconds,
+    # not after minutes of chip time (code-review r5, twice)
+    assembly = (args.affinityAssembly
+                or os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted"))
+    if assembly not in ("sorted", "split", "blocks"):
+        raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
+                         "(sorted | split | blocks)")
+    if assembly == "blocks":
+        if args.spmd:
+            raise SystemExit("--affinityAssembly blocks is single-device; "
+                             "the --spmd pipeline symmetrizes with its own "
+                             "replicated/alltoall strategies (--symMode)")
+        if args.executionPlan:
+            raise SystemExit("--affinityAssembly blocks does not lower an "
+                             "execution plan; use sorted or split for "
+                             "--executionPlan")
+        if (args.devices or jax.device_count()) != 1:
+            raise SystemExit("--affinityAssembly blocks is single-device "
+                             "for now; pass --devices 1 or drop the flag")
+
     t0 = time.time()
     if args.dtype == "bfloat16":
         # MIXED precision, the MXU-native contract: bf16 feeds the distance
@@ -336,24 +357,6 @@ def _main(argv=None) -> int:
         attraction=args.attraction,
         bh_gate=args.bhGate,
     )
-
-    # resolve the assembly BEFORE any expensive stage: blocks is
-    # single-device and has no lowered-plan form — fail in milliseconds,
-    # not after the kNN stage (code-review r5)
-    assembly = (args.affinityAssembly
-                or os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted"))
-    if assembly == "blocks":
-        if args.spmd:
-            raise SystemExit("--affinityAssembly blocks is single-device; "
-                             "the --spmd pipeline symmetrizes with its own "
-                             "replicated/alltoall strategies (--symMode)")
-        if args.executionPlan:
-            raise SystemExit("--affinityAssembly blocks does not lower an "
-                             "execution plan; use sorted or split for "
-                             "--executionPlan")
-        if (args.devices or jax.device_count()) != 1:
-            raise SystemExit("--affinityAssembly blocks is single-device "
-                             "for now; pass --devices 1 or drop the flag")
 
     if args.spmd:
         # the whole job as ONE sharded program (SpmdPipeline); with
