@@ -43,10 +43,13 @@ STEP_TIMEOUT=2400 step baseline_c2 python scripts/run_baseline_configs.py --scal
 STEP_TIMEOUT=2400 step baseline_c3 python scripts/run_baseline_configs.py --scale 1 --configs 3
 # 4b. config 4 from the pre-generated 400k k=90 graph (CLI direct)
 if [ -f .bench_inputs/c4.csv ]; then
+  # blocks assembly: the generated graph carries a ~1e5 in-degree hub
+  # (Z-order highway points become universal neighbors in 100-d), so any
+  # [N, S] layout is ~165 GB; blocks stays O(Nk)
   STEP_TIMEOUT=2400 step baseline_c4 python -m tsne_flink_tpu.utils.cli \
     --input .bench_inputs/c4.csv --output /tmp/c4_out.csv --dimension 100 \
     --knnMethod bruteforce --inputDistanceMatrix --neighbors 90 \
-    --perplexity 30 --iterations 300
+    --perplexity 30 --iterations 300 --affinityAssembly blocks
 fi
 # 4c. config 5's 1.3M workload, single-device on the memory-flat blocks
 # path (the --spmd form cannot compile over this tunnel — shard_map hits

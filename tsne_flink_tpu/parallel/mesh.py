@@ -107,7 +107,8 @@ class ShardedOptimizer:
                 return optimize(state, jidx, jval, cfg_, axis_name=AXIS,
                                 row_offset=row_offset, valid=valid,
                                 start_iter=start_iter, num_iters=num_iters,
-                                loss_carry=loss_carry, edges=edges)
+                                loss_carry=loss_carry, edges=edges,
+                                edges_extra=edges_extra)
 
             pspec = P(AXIS)
             state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
@@ -179,6 +180,47 @@ class ShardedOptimizer:
         return tuple(jnp.concatenate([p[c] for p in parts])
                      for c in range(3))
 
+    def blocks_plan(self, jidx, extra_edges):
+        """Launched attraction pairs for the split-blocks layout — the
+        blocks analog of :meth:`attraction_plan`'s invariant (the bench's
+        FLOP/MFU model must count what actually runs).  Multi-device
+        meshes launch the re-padded per-shard blocks, not the global
+        edge list."""
+        s = int(jidx.shape[1])
+        if self.n_devices == 1:
+            return self.n * s + int(extra_edges[0].shape[0])
+        shards = self._shard_reverse_block(extra_edges)
+        return self.n_padded * s + int(shards[0].shape[0])
+
+    def _shard_reverse_block(self, extra_edges):
+        """Split-blocks reverse block (globally sorted by target row) ->
+        equal-length per-shard LOCAL edge blocks, concatenated so the
+        shard_map pspec hands each device exactly its row range's entries
+        (the blocks analog of :meth:`_build_edges`).  Pad entries carry
+        (src = n_local-1, dst = 0, val = 0): zero force/loss, and the tail
+        keeps src ascending for ``indices_are_sorted``."""
+        rsrc, rdst, rval = (np.asarray(a) for a in extra_edges)
+        nl = self.n_local
+        bounds = np.searchsorted(rsrc,
+                                 np.arange(0, self.n_padded + 1, nl))
+        keep_all = rval > 0  # the global dump tail re-pads per shard
+        counts = [int(keep_all[bounds[d]:bounds[d + 1]].sum())
+                  for d in range(self.n_devices)]
+        e_max = max(1024, (max(counts) + 1023) // 1024 * 1024)
+        d_ = self.n_devices
+        src = np.full((d_, e_max), nl - 1, np.int32)
+        dst = np.zeros((d_, e_max), np.int32)
+        val = np.zeros((d_, e_max), rval.dtype)
+        for d in range(d_):
+            seg = slice(bounds[d], bounds[d + 1])
+            keep = keep_all[seg]
+            c = counts[d]
+            src[d, :c] = rsrc[seg][keep] - d * nl
+            dst[d, :c] = rdst[seg][keep]
+            val[d, :c] = rval[seg][keep]
+        return (jnp.asarray(src.reshape(-1)), jnp.asarray(dst.reshape(-1)),
+                jnp.asarray(val.reshape(-1)))
+
     def _pad_inputs(self, state: TsneState, jidx, jval):
         npad = self.n_padded - self.n
         state = TsneState(y=pad_rows(state.y, npad),
@@ -240,18 +282,19 @@ class ShardedOptimizer:
         the flat edge attraction layout IN-TRACE on each shard — the
         host-side conversion below is impossible there (VERDICT r3 weak #2;
         same gate/threshold as every other path, ops/affinities
-        .edges_beneficial).  ``extra_edges`` (single-device only) is the
-        reverse-only block of the split-blocks layout
-        (ops/affinities.symmetrize_split_blocks): jidx/jval must then be
-        the width-k forward block and attraction sums both — the
-        memory-flat path that never builds [N, S] (round-5 1M-on-one-chip
-        HBM fix)."""
-        if extra_edges is not None and self.n_devices != 1:
+        .edges_beneficial).  ``extra_edges`` is the reverse-only block of
+        the split-blocks layout (ops/affinities.symmetrize_split_blocks):
+        jidx/jval must then be the width-k forward block and attraction
+        sums both — the memory-flat path that never builds [N, S]
+        (round-5 1M-on-one-chip HBM fix).  Multi-device meshes re-slice
+        the block per shard (:meth:`_shard_reverse_block`);
+        multi-controller runs (``pre_padded_valid``) do not support it —
+        their hosts cannot slice the non-addressable global block."""
+        if extra_edges is not None and pre_padded_valid is not None:
             raise NotImplementedError(
-                "split-blocks attraction is single-device for now: the "
-                "reverse block's src rows are global and would need "
-                "routing to shards — use the rows/alltoall SPMD path on "
-                "multi-device meshes")
+                "split-blocks attraction is single-controller: a "
+                "multi-controller host cannot slice the non-addressable "
+                "global reverse block — use the rows/alltoall SPMD path")
         if pre_padded_valid is not None:
             valid = pre_padded_valid
         elif self.n_devices == 1:
@@ -288,7 +331,8 @@ class ShardedOptimizer:
                       "per-shard conversion would overflow int32 slots); "
                       "running the rows layout", file=sys.stderr)
         elif extra_edges is not None:
-            edges = tuple(extra_edges)
+            edges = (tuple(extra_edges) if self.n_devices == 1
+                     else self._shard_reverse_block(extra_edges))
         else:
             edges = self._build_edges(jidx, jval)
         total = self.cfg.iterations

@@ -284,16 +284,20 @@ def _main(argv=None) -> int:
                          "(sorted | split | blocks)")
     if assembly == "blocks":
         if args.spmd:
-            raise SystemExit("--affinityAssembly blocks is single-device; "
-                             "the --spmd pipeline symmetrizes with its own "
-                             "replicated/alltoall strategies (--symMode)")
+            raise SystemExit("--affinityAssembly blocks does not apply to "
+                             "--spmd (that pipeline symmetrizes with its "
+                             "own replicated/alltoall strategies, "
+                             "--symMode); drop --spmd to use blocks — it "
+                             "runs on any single-controller mesh width")
         if args.executionPlan:
             raise SystemExit("--affinityAssembly blocks does not lower an "
                              "execution plan; use sorted or split for "
                              "--executionPlan")
-        if (args.devices or jax.device_count()) != 1:
-            raise SystemExit("--affinityAssembly blocks is single-device "
-                             "for now; pass --devices 1 or drop the flag")
+        if any(v is not None for v in multihost):
+            raise SystemExit("--affinityAssembly blocks is "
+                             "single-controller (the host re-slices the "
+                             "reverse block per shard, which is impossible "
+                             "on non-addressable multi-controller arrays)")
 
     t0 = time.time()
     if args.dtype == "bfloat16":
