@@ -211,10 +211,10 @@ def main():
         raise SystemExit(f"repulsion arg '{repulsion}' not defined "
                          f"({' | '.join(REPULSION_CHOICES)})")
     assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted")
-    if assembly not in ("sorted", "split", "blocks"):
+    if assembly not in ("auto", "sorted", "split", "blocks"):
         # same fail-fast contract as the args above
         raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
-                         "(sorted | split | blocks)")
+                         "(auto | sorted | split | blocks)")
     # blocks runs on any mesh width (ShardedOptimizer re-slices the
     # reverse block per shard); only multi-CONTROLLER runs decline it,
     # and the bench is always single-controller
@@ -298,7 +298,12 @@ def main():
     # builders, ops/affinities.affinity_pipeline) | blocks (edge-direct
     # split: never materializes [N, S] — the 1M-on-one-chip memory path)
     extra = None
-    if assembly == "blocks":
+    if assembly == "auto":
+        from tsne_flink_tpu.ops.affinities import affinity_auto
+        jidx, jval, extra, _label = affinity_auto(idx, dist, cfg.perplexity)
+        if extra is not None:
+            assembly = "blocks"  # the record reports what actually ran
+    elif assembly == "blocks":
         from tsne_flink_tpu.ops.affinities import affinity_blocks
         jidx, jval, extra = affinity_blocks(idx, dist, cfg.perplexity)
     else:

@@ -246,3 +246,37 @@ def test_pipeline_assembly_switch():
         assert set(x_) == set(y_), f"row {r}"
         for j in x_:
             assert x_[j] == pytest.approx(y_[j], rel=1e-10)
+
+
+def test_affinity_auto_switches_on_rows_footprint(monkeypatch, capsys):
+    """affinity_auto: sorted when [N, S] fits the byte limit, blocks when
+    a hub would blow it up (the BASELINE-config-4 165 GB failure class)."""
+    from tsne_flink_tpu.ops.affinities import affinity_auto
+    from tsne_flink_tpu.ops.knn import knn
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((200, 8)).astype(np.float32))
+    idx, dist = knn(x, 10, "bruteforce")
+
+    jidx, jval, extra, label = affinity_auto(idx, dist, 8.0)
+    assert label == "sorted" and extra is None
+    assert jidx.shape[0] == 200 and float(jnp.sum(jval)) == pytest.approx(1.0)
+
+    monkeypatch.setenv("TSNE_ROWS_BYTES_MAX", "1024")  # force the switch
+    jidx2, jval2, extra2, label2 = affinity_auto(idx, dist, 8.0)
+    assert label2 == "blocks" and extra2 is not None
+    assert jidx2.shape == idx.shape  # the forward block IS the kNN structure
+    total = float(jnp.sum(jval2) + jnp.sum(extra2[2]))
+    assert total == pytest.approx(1.0, abs=1e-6)
+
+    # both choices encode the same P
+    a = _rows_to_dicts(jidx, jval)
+    b = _rows_to_dicts(jidx2, jval2)
+    for s_, d_, v_ in zip(np.asarray(extra2[0]), np.asarray(extra2[1]),
+                          np.asarray(extra2[2])):
+        if v_ > 0:
+            b[s_][int(d_)] = float(v_)
+    for r, (x_, y_) in enumerate(zip(a, b)):
+        assert set(x_) == set(y_), f"row {r}"
+        for j in x_:
+            assert x_[j] == pytest.approx(y_[j], rel=1e-6)

@@ -73,9 +73,10 @@ class TSNE:
             raise ValueError(f"repulsion '{repulsion}' not defined "
                              f"({' | '.join(REPULSION_CHOICES)})")
         self.attraction = attraction
-        if affinity_assembly not in (None, "sorted", "split", "blocks"):
+        if affinity_assembly not in (None, "auto", "sorted", "split",
+                                     "blocks"):
             raise ValueError(f"affinity_assembly '{affinity_assembly}' not "
-                             "defined (sorted | split | blocks)")
+                             "defined (auto | sorted | split | blocks)")
         if affinity_assembly is not None and spmd:
             # NOT silently ignored: the spmd pipeline symmetrizes with its
             # own replicated/alltoall strategies, so any assembly override

@@ -355,19 +355,30 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
         # the docstring's promise: the env default reaches THIS branch too,
         # so TSNE_AFFINITY_ASSEMBLY=blocks gets the real blocks path here
         # (tsne_embed supports it) instead of affinity_pipeline's
-        # row-layout demotion
+        # row-layout demotion.  With no env either, 'auto' measures the
+        # [N, S] footprint and protects hub-pathological graphs.
         import os
-        affinity_assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY")
-    if affinity_assembly == "blocks":
+        affinity_assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY", "auto")
+    if affinity_assembly == "auto" and sym_width is not None:
+        # an explicit pinned width IS a row-layout request (shape
+        # stability / reproducing a prior layout) — auto must not ignore it
+        affinity_assembly = "sorted"
+    extra = None
+    if affinity_assembly == "auto":
+        from tsne_flink_tpu.ops.affinities import affinity_auto
+        jidx, jval, extra, _label = affinity_auto(idx, dist, cfg.perplexity)
+    elif affinity_assembly == "blocks":
         from tsne_flink_tpu.ops.affinities import affinity_blocks
         jidx, jval, extra = affinity_blocks(idx, dist, cfg.perplexity)
+    else:
+        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width,
+                                       assembly=affinity_assembly)
+    if extra is not None:
         # edges_extra must be STATIC (a python-level branch in _gradient)
         run_blocks = jax.jit(partial(optimize, cfg=cfg, edges_extra=True))
         state, losses = run_blocks(state, jidx, jval, edges=extra)
         return state.y, losses
     run = jax.jit(partial(optimize, cfg=cfg))
-    jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width,
-                                   assembly=affinity_assembly)
     edges = None
     from tsne_flink_tpu.ops.affinities import assemble_edges, plan_edges
     use_edges, e_pad = plan_edges(jidx, jval, cfg.attraction)

@@ -114,7 +114,12 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
 
     if assembly is None:
         assembly = _os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted")
-        if assembly == "blocks":
+        if assembly == "auto":
+            # auto's memory protection needs the blocks return shape, which
+            # this row-layout caller cannot consume — its rows are simply
+            # the default builder
+            assembly = "sorted"
+        elif assembly == "blocks":
             # blocks is an edge-direct layout with a different return shape
             # (see affinity_blocks); row-layout consumers reading the env
             # get split — the SAME P, TPU-fast, in the shape they expect —
@@ -318,6 +323,49 @@ def joint_distribution_split(idx: jnp.ndarray, p: jnp.ndarray,
     if return_row_deg:
         out.append((jnp.sum(present, axis=1) + rev_deg).astype(jnp.int32))
     return tuple(out)
+
+
+#: auto assembly: switch to blocks when jidx+jval at the sorted bound
+#: would exceed this many bytes (override: TSNE_ROWS_BYTES_MAX).  4 GiB
+#: keeps every [N, S] workload that fits comfortably on a v5e chip or a
+#: small host on the golden-comparable sorted path, and diverts the
+#: hub-pathological ones (BASELINE config 4's generated graph: a ~1e5
+#: in-degree hub made [N, S] a 165 GB allocation) to the O(Nk) blocks
+#: layout instead of an OOM.
+ROWS_BYTES_MAX = 4 << 30
+
+
+def affinity_auto(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
+                  rows_bytes_max: int | None = None):
+    """Width-aware assembly choice: measure the sorted layout's [N, S]
+    footprint FIRST, then build with the sorted assembly when it fits and
+    the edge-direct blocks layout when it would not.  Returns
+    ``(jidx, jval, extra_edges, label)`` with ``extra_edges=None`` and
+    ``label='sorted'`` for the row layout, else the blocks triple and
+    ``label='blocks'`` (consume like :func:`affinity_blocks`)."""
+    import os as _os
+    import sys as _sys
+
+    import jax as _jax
+    from functools import partial as _partial
+
+    if rows_bytes_max is None:
+        rows_bytes_max = int(_os.environ.get("TSNE_ROWS_BYTES_MAX",
+                                             ROWS_BYTES_MAX))
+    p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
+    w = int(_jax.jit(symmetrized_width)(idx, p_cond))
+    n = int(idx.shape[0])
+    itemsize = jnp.dtype(p_cond.dtype).itemsize
+    rows_bytes = n * w * (4 + itemsize)  # jidx int32 + jval
+    if rows_bytes <= rows_bytes_max:
+        jidx, jval = _jax.jit(_partial(joint_distribution, sym_width=w))(
+            idx, p_cond)
+        return jidx, jval, None, "sorted"
+    print(f"# affinity assembly auto: [N={n}, S={w}] rows need "
+          f"{rows_bytes / 2**30:.1f} GiB (> {rows_bytes_max / 2**30:.1f}); "
+          "using the O(Nk) blocks layout", file=_sys.stderr)
+    fwd_val, rsrc, rdst, rval = _jax.jit(symmetrize_split_blocks)(idx, p_cond)
+    return idx, fwd_val, (rsrc, rdst, rval), "blocks"
 
 
 def affinity_blocks(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float):

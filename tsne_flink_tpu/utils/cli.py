@@ -75,15 +75,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "edge list sized by the true edge count (auto: edges "
                         "when hub rows make S >= 2x the mean degree)")
     p.add_argument("--affinityAssembly", default=None,
-                   choices=["sorted", "split", "blocks"],
+                   choices=["auto", "sorted", "split", "blocks"],
                    help="symmetrized-P builder: sorted = 2-key sort + "
                         "scatter into [N,S] rows (golden-comparable), "
                         "split = gather-merge + 1-key sort into the same "
                         "[N,S] (TPU-fast), blocks = edge-direct split that "
                         "never materializes [N,S] (memory-flat; the "
-                        "1M-on-one-chip path; single-device, not with "
-                        "--spmd/--executionPlan).  Default: "
-                        "$TSNE_AFFINITY_ASSEMBLY or sorted")
+                        "1M-on-one-chip path; not with "
+                        "--spmd/--executionPlan).  auto (default) measures "
+                        "the [N,S] footprint first and picks sorted when "
+                        "it fits (TSNE_ROWS_BYTES_MAX, 4 GiB) else blocks "
+                        "— hub-pathological graphs embed instead of "
+                        "OOM-ing.  Env default: $TSNE_AFFINITY_ASSEMBLY")
     p.add_argument("--bhGate", default="vdm", choices=["vdm", "flink"],
                    help="BH acceptance test: vdm = side/sqrt(D) < theta "
                         "(scale-free, accurate); flink = the reference's "
@@ -278,10 +281,17 @@ def _main(argv=None) -> int:
     # unsupported combination (or an env typo) must fail in milliseconds,
     # not after minutes of chip time (code-review r5, twice)
     assembly = (args.affinityAssembly
-                or os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted"))
-    if assembly not in ("sorted", "split", "blocks"):
+                or os.environ.get("TSNE_AFFINITY_ASSEMBLY", "auto"))
+    if assembly not in ("auto", "sorted", "split", "blocks"):
         raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
-                         "(sorted | split | blocks)")
+                         "(auto | sorted | split | blocks)")
+    if assembly == "auto" and args.executionPlan:
+        # the plan dump wants a lowerable rows program, and auto's choice
+        # is data-dependent (post-kNN) — resolve NOW, per the fail-fast
+        # rule above, instead of aborting after the expensive stages
+        print("# --executionPlan: assembly auto resolves to sorted (the "
+              "blocks layout has no lowered-plan form)", file=sys.stderr)
+        assembly = "sorted"
     if assembly == "blocks":
         if args.spmd:
             raise SystemExit("--affinityAssembly blocks does not apply to "
@@ -431,7 +441,11 @@ def _main(argv=None) -> int:
         return 0
 
     extra_edges = None
-    if assembly == "blocks":
+    if assembly == "auto":  # executionPlan runs resolved to sorted above
+        from tsne_flink_tpu.ops.affinities import affinity_auto
+        jidx, jval, extra_edges, label = affinity_auto(idx, dist,
+                                                       cfg.perplexity)
+    elif assembly == "blocks":
         from tsne_flink_tpu.ops.affinities import affinity_blocks
         jidx, jval, extra_edges = affinity_blocks(idx, dist, cfg.perplexity)
     else:
