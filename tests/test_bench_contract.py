@@ -53,10 +53,19 @@ def test_every_line_is_a_complete_record():
 
 
 def test_deadline_stop_leaves_labeled_extrapolation():
-    # knn+affinities at n=800 take a few seconds; a deadline that expires
-    # during the first optimize segments forces the _DeadlineStop path
-    recs = run_bench(800, 200, {"TSNE_BENCH_DEADLINE_S": "12",
-                                "TSNE_BENCH_MARGIN_S": "2"})
+    # the deadline must expire DURING optimize for the _DeadlineStop path
+    # to fire.  A wall-clock deadline alone is machine-speed-dependent (a
+    # warm persistent cache once made 800 x 200 finish inside 12 s and the
+    # test saw a complete run instead) — so pin the clock: backdate T0 so
+    # _remaining() is hugely negative at the first segment callback (the
+    # only deadline check, bench.py cb), which then always raises
+    # _DeadlineStop; SEG=10 guarantees that first callback happens well
+    # before iteration 200 (the callback is skipped at it == total)
+    import time
+    recs = run_bench(800, 200, {
+        "TSNE_BENCH_T0": repr(time.time() - 3600),
+        "TSNE_BENCH_DEADLINE_S": "3600.5",
+        "TSNE_BENCH_MARGIN_S": "2", "TSNE_BENCH_SEG": "10"})
     final = recs[-1]
     assert final.get("extrapolated") is True
     assert 0 < final["iterations_run"] < 200
