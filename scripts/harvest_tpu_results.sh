@@ -1,0 +1,62 @@
+#!/bin/bash
+# Copy each queue step's final record from .tpu_queue/ (gitignored) into
+# results/ (committed evidence).  Idempotent; run any time.  queue2 calls
+# this after every step block so a round boundary cannot strand
+# freshly-measured on-chip numbers in an ignored directory.
+#
+# Contract (code-review r5): a destination is written ONLY when the log
+# holds a real payload — a failed/aborted step can neither publish a
+# stack trace as evidence nor truncate a previously good file — and the
+# summary counts what THIS invocation wrote.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p results
+wrote=0
+
+put() {  # put <dest> <content> — skip empty payloads, write atomically
+  local dest=$1 content=$2
+  [ -n "$content" ] || return 0
+  printf '%s\n' "$content" > "$dest.tmp" && mv "$dest.tmp" "$dest"
+  wrote=$((wrote + 1))
+}
+
+# bench-style steps: the last superseding JSON line is the record
+for log in .tpu_queue/bench_60k_split.log .tpu_queue/bench_60k_blocks.log \
+           .tpu_queue/bench_60k_exact_blocks.log \
+           .tpu_queue/bench_1m_blocks.log; do
+  [ -f "$log" ] || continue
+  put "results/$(basename "$log" .log)_tpu.json" \
+      "$(grep -h '^{' "$log" | tail -1)"
+done
+
+# stage profiles: every JSON line is a sub-stage row
+if [ -f .tpu_queue/profile_affinities.log ]; then
+  put results/profile_affinities_tpu.txt \
+      "$(grep -h '^{' .tpu_queue/profile_affinities.log)"
+fi
+if [ -f .tpu_queue/profile_60k.log ]; then
+  put results/profile_60k_tpu.txt \
+      "$(grep -h '^{\|^stage\|seconds' .tpu_queue/profile_60k.log)"
+fi
+
+# BH error sweeps: only the plateau table rows are evidence
+for d in "" "_3d"; do
+  log=".tpu_queue/bh_100k${d}.log"
+  [ -f "$log" ] || continue
+  put "results/bh_error_100k${d}_tpu.txt" \
+      "$(grep -hE 'frontier|theta|err' "$log")"
+done
+
+if [ -f .tpu_queue/quality_60k.log ]; then
+  put results/quality_60k_tpu.json \
+      "$(grep -h '^{' .tpu_queue/quality_60k.log | tail -1)"
+fi
+
+# CLI-direct config steps: the success line carries the timing record
+for c in c4 c5; do
+  log=".tpu_queue/baseline_${c}.log"
+  [ -f "$log" ] || continue
+  put "results/baseline_${c}_cli_tpu.txt" \
+      "$(grep -h 'embedded .* points' "$log" | tail -1)"
+done
+
+echo "harvest: wrote $wrote evidence file(s) this pass"

@@ -36,8 +36,10 @@ STEP_TIMEOUT=1500 step bench_60k_split env TSNE_AFFINITY_ASSEMBLY=split python b
 STEP_TIMEOUT=1500 step bench_60k_blocks env TSNE_AFFINITY_ASSEMBLY=blocks python bench.py 60000 300 fft
 # 2b. exact repulsion with the best-so-far assembly: the 60k frontrunner
 STEP_TIMEOUT=1500 step bench_60k_exact_blocks env TSNE_AFFINITY_ASSEMBLY=blocks python bench.py 60000 300 exact
+bash scripts/harvest_tpu_results.sh >> $Q/queue2.log
 # 3. the 1M north star on the memory-flat path
 STEP_TIMEOUT=2400 step bench_1m_blocks env TSNE_AFFINITY_ASSEMBLY=blocks python bench.py 1000000 300 fft
+bash scripts/harvest_tpu_results.sh >> $Q/queue2.log
 # 4. BASELINE configs on-chip: 2 and 3 via the runner (fresh inputs)
 STEP_TIMEOUT=2400 step baseline_c2 python scripts/run_baseline_configs.py --scale 1 --configs 2
 STEP_TIMEOUT=2400 step baseline_c3 python scripts/run_baseline_configs.py --scale 1 --configs 3
@@ -61,9 +63,11 @@ if [ -f .bench_inputs/c5.csv ]; then
     --knnMethod project --perplexity 50 --iterations 60 \
     --affinityAssembly blocks
 fi
+bash scripts/harvest_tpu_results.sh >> $Q/queue2.log
 # 5. the rest of the first queue's evidence items
 STEP_TIMEOUT=1800 step bh_100k python scripts/measure_bh_error.py 100000
 STEP_TIMEOUT=1800 step bh_100k_3d python scripts/measure_bh_error.py 100000 --dims 3 --auto
 STEP_TIMEOUT=1200 step profile_60k python scripts/profile_stages.py 60000 50 fft
 STEP_TIMEOUT=3600 step quality_60k env TSNE_QUALITY_BACKEND=tpu python scripts/quality_60k.py
 echo "=== queue2 complete [$(date +%H:%M:%S)]" | tee -a $Q/queue2.log
+bash scripts/harvest_tpu_results.sh | tee -a $Q/queue2.log
