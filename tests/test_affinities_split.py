@@ -249,7 +249,8 @@ def test_pipeline_assembly_switch():
 
 
 def test_affinity_auto_switches_on_rows_footprint(monkeypatch, capsys):
-    """affinity_auto: sorted when [N, S] fits the byte limit, blocks when
+    """affinity_auto: split-built rows when [N, S] fits the byte limit,
+    blocks when
     a hub would blow it up (the BASELINE-config-4 165 GB failure class)."""
     from tsne_flink_tpu.ops.affinities import affinity_auto
     from tsne_flink_tpu.ops.knn import knn
@@ -259,7 +260,7 @@ def test_affinity_auto_switches_on_rows_footprint(monkeypatch, capsys):
     idx, dist = knn(x, 10, "bruteforce")
 
     jidx, jval, extra, label = affinity_auto(idx, dist, 8.0)
-    assert label == "sorted" and extra is None
+    assert label == "split-rows" and extra is None
     assert jidx.shape[0] == 200 and float(jnp.sum(jval)) == pytest.approx(1.0)
 
     monkeypatch.setenv("TSNE_ROWS_BYTES_MAX", "1024")  # force the switch

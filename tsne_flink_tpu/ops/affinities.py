@@ -325,24 +325,26 @@ def joint_distribution_split(idx: jnp.ndarray, p: jnp.ndarray,
     return tuple(out)
 
 
-#: auto assembly: switch to blocks when jidx+jval at the sorted bound
-#: would exceed this many bytes (override: TSNE_ROWS_BYTES_MAX).  4 GiB
-#: keeps every [N, S] workload that fits comfortably on a v5e chip or a
-#: small host on the golden-comparable sorted path, and diverts the
-#: hub-pathological ones (BASELINE config 4's generated graph: a ~1e5
-#: in-degree hub made [N, S] a 165 GB allocation) to the O(Nk) blocks
-#: layout instead of an OOM.
+#: auto assembly: switch to blocks when jidx+jval at the split builder's
+#: exact lossless width would exceed this many bytes (override:
+#: TSNE_ROWS_BYTES_MAX).  4 GiB keeps every [N, S] workload that fits
+#: comfortably on a v5e chip or a small host on the split row builder
+#: (golden-identical P, the fastest measured on both backends), and
+#: diverts the hub-pathological ones (BASELINE config 4's generated
+#: graph: a ~1e5 in-degree hub made [N, S] a 165 GB allocation) to the
+#: O(Nk) blocks layout instead of an OOM.
 ROWS_BYTES_MAX = 4 << 30
 
 
 def affinity_auto(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
                   rows_bytes_max: int | None = None):
-    """Width-aware assembly choice: measure the sorted layout's [N, S]
-    footprint FIRST, then build with the sorted assembly when it fits and
-    the edge-direct blocks layout when it would not.  Returns
-    ``(jidx, jval, extra_edges, label)`` with ``extra_edges=None`` and
-    ``label='sorted'`` for the row layout, else the blocks triple and
-    ``label='blocks'`` (consume like :func:`affinity_blocks`)."""
+    """Width-aware assembly choice: measure the row layout's exact [N, S]
+    footprint FIRST, then build rows (via the split builder, at its
+    lossless width) when they fit and the edge-direct blocks layout when
+    they would not.  Returns ``(jidx, jval, extra_edges, label)`` with
+    ``extra_edges=None`` and ``label='split-rows'`` for the row layout,
+    else the blocks triple and ``label='blocks'`` (consume like
+    :func:`affinity_blocks`)."""
     import os as _os
     import sys as _sys
 
@@ -353,18 +355,26 @@ def affinity_auto(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
         rows_bytes_max = int(_os.environ.get("TSNE_ROWS_BYTES_MAX",
                                              ROWS_BYTES_MAX))
     p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
-    w = int(_jax.jit(symmetrized_width)(idx, p_cond))
+    w, rev = _jax.jit(_partial(split_width, return_rev=True))(idx, p_cond)
+    w = int(w)
     n = int(idx.shape[0])
     itemsize = jnp.dtype(p_cond.dtype).itemsize
     rows_bytes = n * w * (4 + itemsize)  # jidx int32 + jval
     if rows_bytes <= rows_bytes_max:
-        jidx, jval = _jax.jit(_partial(joint_distribution, sym_width=w))(
-            idx, p_cond)
-        return jidx, jval, None, "sorted"
+        # rows are built by the SPLIT builder at ITS exact lossless width
+        # (the footprint judged is the footprint allocated; the rev pass
+        # is reused): identical P to the sorted assembly — pinned against
+        # the reference goldens — and measurably faster: 1.9x at the 60k
+        # bench shape on CPU (results/profile_affinities_cpu.txt), and
+        # sort/scatter-light where the on-chip sorted stage inverted 7-14x
+        jidx, jval = _jax.jit(_partial(joint_distribution_split,
+                                       sym_width=w))(idx, p_cond, rev=rev)
+        return jidx, jval, None, "split-rows"
     print(f"# affinity assembly auto: [N={n}, S={w}] rows need "
           f"{rows_bytes / 2**30:.1f} GiB (> {rows_bytes_max / 2**30:.1f}); "
           "using the O(Nk) blocks layout", file=_sys.stderr)
-    fwd_val, rsrc, rdst, rval = _jax.jit(symmetrize_split_blocks)(idx, p_cond)
+    fwd_val, rsrc, rdst, rval = _jax.jit(symmetrize_split_blocks)(
+        idx, p_cond, rev=rev)  # the width pass's membership values, reused
     return idx, fwd_val, (rsrc, rdst, rval), "blocks"
 
 

@@ -83,10 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "never materializes [N,S] (memory-flat; the "
                         "1M-on-one-chip path; not with "
                         "--spmd/--executionPlan).  auto (default) measures "
-                        "the [N,S] footprint first and picks sorted when "
-                        "it fits (TSNE_ROWS_BYTES_MAX, 4 GiB) else blocks "
-                        "— hub-pathological graphs embed instead of "
-                        "OOM-ing.  Env default: $TSNE_AFFINITY_ASSEMBLY")
+                        "the [N,S] footprint first and builds rows via "
+                        "split when they fit (TSNE_ROWS_BYTES_MAX, 4 GiB) "
+                        "else blocks — hub-pathological graphs embed "
+                        "instead of OOM-ing.  Env default: "
+                        "$TSNE_AFFINITY_ASSEMBLY")
     p.add_argument("--bhGate", default="vdm", choices=["vdm", "flink"],
                    help="BH acceptance test: vdm = side/sqrt(D) < theta "
                         "(scale-free, accurate); flink = the reference's "
