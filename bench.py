@@ -192,9 +192,7 @@ def main():
 
     from tsne_flink_tpu.models.tsne import (LOSS_EVERY, TsneConfig,
                                             init_working_set)
-    from tsne_flink_tpu.ops.affinities import affinity_pipeline
-    from tsne_flink_tpu.ops.knn import (knn as knn_dispatch,
-                                        pick_knn_refine, pick_knn_rounds)
+    from tsne_flink_tpu.ops.knn import pick_knn_refine, pick_knn_rounds
     from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
@@ -210,7 +208,12 @@ def main():
     if repulsion not in REPULSION_CHOICES:
         raise SystemExit(f"repulsion arg '{repulsion}' not defined "
                          f"({' | '.join(REPULSION_CHOICES)})")
-    assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted")
+    # default assembly now matches the CLI / tsne_embed default ('auto' —
+    # ADVICE r5 #3): bench records through round 5 were produced under the
+    # old 'sorted' default; the 'assembly' key every record now carries is
+    # what makes those eras comparable (pre-r6 records without the key are
+    # sorted-era unless their env said otherwise)
+    assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY", "auto")
     if assembly not in ("auto", "sorted", "split", "blocks"):
         # same fail-fast contract as the args above
         raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
@@ -237,6 +240,29 @@ def main():
         # warm the one-time Mosaic lowering probe outside any trace
         from tsne_flink_tpu.ops.repulsion_pallas import mosaic_supported
         mosaic_supported()
+    # backend-aware matmul default (VERDICT r5 next-round #3), same as a
+    # defaulted CLI run: the f32 workload on TPU feeds bf16 matmul operands
+    # (quality pinned indistinguishable, results/quality_bf16.txt);
+    # TSNE_MATMUL_F32=1 pins pure f32 for A/B evidence.  Set BEFORE any
+    # trace (ops/metrics.set_matmul_dtype contract).
+    from tsne_flink_tpu.ops.metrics import default_matmul_dtype, \
+        set_matmul_dtype
+    matmul_label = "float32"
+    if os.environ.get("TSNE_MATMUL_F32", "").lower() not in ("1", "true"):
+        md = default_matmul_dtype()
+        if md is not None:
+            set_matmul_dtype(md)
+            matmul_label = str(jnp.dtype(md))
+
+    # prepare-artifact cache (utils/artifacts.py): on by default so every
+    # rerun of the same (n, plan) — backend A/B, theta sweep, repeat bench —
+    # starts the optimize loop in seconds; the record labels itself
+    # cache: cold|warm|mixed|off so a warm number can never masquerade as a
+    # cold one.  TSNE_ARTIFACTS=0 disables, TSNE_ARTIFACT_DIR moves the root.
+    art_cache = None
+    if os.environ.get("TSNE_ARTIFACTS", "1").lower() not in ("0", "false"):
+        from tsne_flink_tpu.utils.artifacts import ArtifactCache
+        art_cache = ArtifactCache()
 
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=theta,
                      repulsion=repulsion, attraction=attraction,
@@ -266,6 +292,13 @@ def main():
         "theta": cfg.theta, "knn_rounds": rounds, "knn_refine": refine,
         "data": DATA_PROVENANCE, "data_seed": DATA_SEED,
         "peak_flops": peak, "peak_flops_basis": basis,
+        # self-describing records (ADVICE r5 #1): the REQUESTED assembly
+        # here, overwritten with the RESOLVED label (incl. affinity_auto's
+        # split-rows/blocks outcome) the moment the prepare stage fixes it;
+        # "cache" likewise goes cold|warm|mixed once the stages report
+        "assembly": assembly,
+        "cache": "off" if art_cache is None else "cold",
+        "matmul_dtype": matmul_label,
     }
 
     def emit_partial(measured_s, est_total_s, stages, note):
@@ -277,40 +310,45 @@ def main():
                "estimate_basis": note})
 
     x = jnp.asarray(x_np)
-    t0 = time.time()
-    idx, dist = jax.jit(
-        lambda xx: knn_dispatch(xx, k, "project", rounds=rounds,
-                                refine=refine, key=jax.random.key(0)))(x)
-    idx.block_until_ready()
-    t_knn = time.time() - t0
     # f_opt is not known exactly until the affinity stage fixes the row
     # width; use the row-layout upper bound (s <= 2k) for the estimate
     f_opt_guess = optimize_flops(n, 2 * k, 2, iters, repulsion,
                                  theta=cfg.theta,
                                  mpad=8 if backend == "tpu" else 3)
-    rate = f_knn / max(t_knn, 1e-9)
-    emit_partial(t_knn, t_knn + (f_aff + f_opt_guess) / rate,
-                 {"knn": t_knn},
-                 "knn measured; affinities+optimize scaled by knn FLOP rate")
 
-    t1 = time.time()
-    # assembly (validated at startup): sorted | split (A/B of the [N, S]
-    # builders, ops/affinities.affinity_pipeline) | blocks (edge-direct
-    # split: never materializes [N, S] — the 1M-on-one-chip memory path)
-    extra = None
-    if assembly == "auto":
-        from tsne_flink_tpu.ops.affinities import affinity_auto
-        jidx, jval, extra, _label = affinity_auto(idx, dist, cfg.perplexity)
-        if extra is not None:
-            assembly = "blocks"  # the record reports what actually ran
-    elif assembly == "blocks":
-        from tsne_flink_tpu.ops.affinities import affinity_blocks
-        jidx, jval, extra = affinity_blocks(idx, dist, cfg.perplexity)
-    else:
-        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity,
-                                       assembly=assembly)
-    jval.block_until_ready()
-    t_aff = time.time() - t1
+    # the shared prepare stage (utils/artifacts.prepare — also the CLI's
+    # and tsne_embed's), artifact cache layered on top; the on_stage hook
+    # keeps the window-proof partial record between kNN and affinities.
+    # A cache-loaded stage contributes ZERO FLOPs to every rate/MFU figure
+    # — a warm run must never claim the arithmetic it skipped.
+    def on_stage(stage, secs, cache_state):
+        if stage != "knn":
+            return
+        f_knn_m = 0.0 if cache_state == "warm" else f_knn
+        r = f_knn_m / max(secs, 1e-9)
+        if r > 0:
+            emit_partial(secs, secs + (f_aff + f_opt_guess) / r,
+                         {"knn": secs},
+                         "knn measured; affinities+optimize scaled by knn "
+                         "FLOP rate")
+        else:
+            emit_partial(secs, secs, {"knn": secs},
+                         "knn loaded from artifact cache; no FLOP-rate "
+                         "basis for the remainder yet")
+
+    from tsne_flink_tpu.utils.artifacts import prepare as prepare_stage
+    prep = prepare_stage(x, neighbors=k, knn_method="project",
+                         knn_rounds=rounds, knn_refine=refine,
+                         key=jax.random.key(0), perplexity=cfg.perplexity,
+                         assembly=assembly, cache=art_cache,
+                         on_stage=on_stage)
+    t_knn, t_aff = prep.knn_seconds, prep.affinity_seconds
+    jidx, jval, extra = prep.jidx, prep.jval, prep.extra_edges
+    label = prep.label
+    base["assembly"] = label   # the record reports what actually ran
+    base["cache"] = prep.cache_label
+    f_knn_run = 0.0 if prep.knn_cache == "warm" else f_knn
+    f_aff_run = 0.0 if prep.affinity_cache == "warm" else f_aff
 
     state = init_working_set(jax.random.key(0), n, 2, jnp.float32)
     runner = ShardedOptimizer(cfg, n)
@@ -319,7 +357,7 @@ def main():
     # FLOP model counts the launched pairs (utils/flops.py) — single- AND
     # multi-device (the decision lives in ONE place: affinities.plan_edges
     # via ShardedOptimizer.attraction_plan)
-    if assembly == "blocks":
+    if label == "blocks":
         # launched-pair count from the runner itself (re-padded per-shard
         # blocks on a mesh), so the FLOP model cannot drift from the run
         layout, pairs = "blocks", runner.blocks_plan(jidx, extra)
@@ -331,10 +369,14 @@ def main():
                            nnz_pairs=pairs if use_edges else None,
                            theta=cfg.theta,  # bh auto-frontier mirror
                            mpad=8 if backend == "tpu" else 3)
-    rate = (f_knn + f_aff) / max(t_knn + t_aff, 1e-9)
-    emit_partial(t_knn + t_aff, t_knn + t_aff + f_opt / rate,
+    rate = (f_knn_run + f_aff_run) / max(t_knn + t_aff, 1e-9)
+    emit_partial(t_knn + t_aff,
+                 t_knn + t_aff + (f_opt / rate if rate > 0 else 0.0),
                  {"knn": t_knn, "affinities": t_aff},
-                 "knn+affinities measured; optimize scaled by FLOP rate")
+                 "knn+affinities measured; optimize scaled by FLOP rate"
+                 if rate > 0 else
+                 "prepare loaded from artifact cache; optimize not yet "
+                 "measured")
 
     # ---- optimize, in fixed-size bit-identical segments (one compiled
     # executable — start_iter and the loss trace are traced arguments) with
@@ -352,7 +394,8 @@ def main():
 
     def est_total_at(it_done):
         if it_done <= 0:
-            return t_knn + t_aff + f_opt / rate
+            return (t_knn + t_aff + (f_opt / rate if rate > 0
+                                     else 0.0))  # warm prepare: no rate
         return t_knn + t_aff + opt_elapsed() * iters / it_done
 
     def cb(state_u, next_iter, losses):
@@ -395,9 +438,14 @@ def main():
                                 nnz_pairs=pairs if use_edges else None,
                                 theta=cfg.theta,
                                 mpad=8 if backend == "tpu" else 3)
-    flops = f_knn + f_aff + f_opt  # full-workload FLOPs (matches "value")
+    # FLOPs EXECUTED this run: cache-loaded stages contribute zero (their
+    # arithmetic was paid by the cold run that populated the artifact), so
+    # a warm run's MFU cannot be inflated by work it never did.  For a
+    # cold/off run these equal the full workload, as before.
+    flops = f_knn_run + f_aff_run + f_opt  # matches "value"
     measured_s = t_knn + t_aff + t_opt
-    measured_flops = f_knn + f_aff + (f_opt if complete else f_opt_done)
+    measured_flops = f_knn_run + f_aff_run + (f_opt if complete
+                                              else f_opt_done)
     # MFU from MEASURED work over MEASURED time — extrapolation cancels out
     mfu = round(measured_flops / (measured_s * peak), 5) if peak else None
     rec = {**base,
@@ -408,9 +456,11 @@ def main():
            # stage_flops pairs with the MEASURED "stages" seconds, so an
            # extrapolated record carries the partial-run optimize FLOPs
            # (full-workload FLOPs live in "flops", matching "value")
-           "stage_flops": {"knn": f_knn, "affinities": f_aff,
+           "stage_flops": {"knn": f_knn_run, "affinities": f_aff_run,
                            "optimize": f_opt if complete else f_opt_done},
            "flops": flops, "mfu": mfu,
+           "cache_stages": {"knn": prep.knn_cache,
+                            "affinities": prep.affinity_cache},
            "final_kl": round(final_kl, 4) if final_kl is not None else None,
            "sym_width": s, "attraction": layout, "attraction_pairs": pairs}
     if not complete:

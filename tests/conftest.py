@@ -23,6 +23,7 @@ _FAST_MODULES = {
     "test_optimizer",
     "test_flops", "test_edge_cases", "test_native_io", "test_pallas",
     "test_checkpoint", "test_cli", "test_quality_gate", "test_cache",
+    "test_artifacts",
 }
 
 
@@ -34,6 +35,12 @@ def pytest_collection_modifyitems(config, items):
         item.add_marker(pytest.mark.fast if mod in _FAST_MODULES
                         else pytest.mark.slow)
 
+
+# hermetic prepare-artifact cache: in-process CLI/bench tests must not read
+# or write the repo-local .tsne_artifacts (a warm hit from a PREVIOUS test
+# run would mask cold-path bugs).  Tests that exercise the cache pass an
+# explicit --cacheDir / ArtifactCache(tmp_path), which overrides this.
+os.environ.setdefault("TSNE_ARTIFACTS", "0")
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

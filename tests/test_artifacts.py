@@ -1,0 +1,211 @@
+"""Prepare-artifact cache contract (the PR-1 tentpole).
+
+The cache's one promise: a warm hit is BIT-IDENTICAL to the cold path —
+the optimize loop cannot tell whether its P came from arithmetic or from
+disk.  Everything else here guards the ways that promise could silently
+break: corrupt files, foreign files, fingerprint drift when any prepare
+input changes, and the assembled-layout variants (auto / sorted / split /
+blocks, including the blocks extra-edges triple).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.utils.artifacts import (ArtifactCache, KIND_AFFINITY,
+                                            KIND_KNN, data_fingerprint,
+                                            prepare, prepare_fingerprints)
+
+
+def blobs(n=80, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, d)) * 4.0
+    x = centers[rng.integers(0, 3, n)] + rng.normal(size=(n, d))
+    return jnp.asarray(x, jnp.float32)
+
+
+KW = dict(neighbors=10, knn_method="bruteforce", perplexity=5.0)
+
+
+def run(x, cache, assembly="auto", **over):
+    kw = dict(KW, assembly=assembly, cache=cache, key=jax.random.key(7))
+    kw.update(over)
+    return prepare(x, **kw)
+
+
+@pytest.mark.parametrize("assembly", ["auto", "sorted", "split", "blocks"])
+def test_warm_hit_bit_identical(tmp_path, assembly):
+    x = blobs()
+    cache = ArtifactCache(str(tmp_path))
+    cold = run(x, cache, assembly)
+    warm = run(x, cache, assembly)
+    assert warm.knn_cache == "warm" and warm.affinity_cache == "warm"
+    assert warm.label == cold.label
+    np.testing.assert_array_equal(np.asarray(cold.idx), np.asarray(warm.idx))
+    np.testing.assert_array_equal(np.asarray(cold.dist),
+                                  np.asarray(warm.dist))
+    np.testing.assert_array_equal(np.asarray(cold.jidx),
+                                  np.asarray(warm.jidx))
+    np.testing.assert_array_equal(np.asarray(cold.jval),
+                                  np.asarray(warm.jval))
+    if cold.extra_edges is None:
+        assert warm.extra_edges is None
+    else:
+        for a, b in zip(cold.extra_edges, warm.extra_edges):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and both match the cache-off path exactly (the cold path IS the
+    # uncached computation; nothing about caching may perturb it)
+    off = run(x, None, assembly)
+    assert off.knn_cache == "off" and off.affinity_cache == "off"
+    np.testing.assert_array_equal(np.asarray(off.jidx), np.asarray(warm.jidx))
+    np.testing.assert_array_equal(np.asarray(off.jval), np.asarray(warm.jval))
+
+
+def test_knn_artifact_shared_across_assemblies(tmp_path):
+    """The kNN graph depends on no affinity knob: a sorted-assembly run
+    must warm-hit the kNN entry a split-assembly run wrote."""
+    x = blobs()
+    cache = ArtifactCache(str(tmp_path))
+    run(x, cache, "split")
+    second = run(x, cache, "sorted")
+    assert second.knn_cache == "warm"      # shared
+    assert second.affinity_cache == "cold"  # per-assembly
+    assert second.cache_label == "mixed"
+
+
+def test_fingerprint_miss_on_any_input_change(tmp_path):
+    x = blobs()
+    cache = ArtifactCache(str(tmp_path))
+    base = run(x, cache)
+    assert run(x, cache).affinity_cache == "warm"  # sanity: same -> hit
+    # each varied input must produce a different fingerprint -> a miss
+    assert run(x, cache, perplexity=6.0).affinity_cache == "cold"
+    assert run(x, cache, neighbors=12).knn_cache == "cold"
+    assert run(x, cache, key=jax.random.key(8)).knn_cache == "warm", \
+        "bruteforce ignores the key; it must be normalized out"
+    x2 = blobs(seed=1)
+    changed = run(x2, cache)
+    assert changed.knn_cache == "cold"
+    assert changed.knn_fp != base.knn_fp
+
+
+def test_project_key_and_plan_in_fingerprint():
+    """project kNN consumes the PRNG key and the rounds/refine plan — all
+    three must move the fingerprint (bruteforce normalizes them away)."""
+    x = blobs()
+    kw = dict(KW, knn_method="project", assembly="auto")
+    fp0, _ = prepare_fingerprints(x, key=jax.random.key(1), **kw)
+    fp_key, _ = prepare_fingerprints(x, key=jax.random.key(2), **kw)
+    fp_rounds, _ = prepare_fingerprints(x, key=jax.random.key(1),
+                                        knn_rounds=9, **kw)
+    assert fp0 != fp_key and fp0 != fp_rounds
+    # auto rounds/refine resolve BEFORE hashing: an explicit value equal to
+    # the auto policy hits the same entry
+    from tsne_flink_tpu.ops.knn import pick_knn_refine, pick_knn_rounds
+    n, d = x.shape
+    fp_resolved, _ = prepare_fingerprints(
+        x, key=jax.random.key(1), knn_rounds=pick_knn_rounds(n),
+        knn_refine=pick_knn_refine(n, d), **kw)
+    assert fp0 == fp_resolved
+
+
+def test_corrupt_artifact_is_removed_and_recomputed(tmp_path):
+    x = blobs()
+    cache = ArtifactCache(str(tmp_path))
+    cold = run(x, cache)
+    path = cache.path(KIND_AFFINITY, cold.affinity_fp)
+    with open(path, "wb") as f:
+        f.write(b"not an npz at all")
+    again = run(x, cache)
+    assert again.affinity_cache == "cold"  # recomputed, not trusted
+    np.testing.assert_array_equal(np.asarray(cold.jval),
+                                  np.asarray(again.jval))
+    assert run(x, cache).affinity_cache == "warm"  # save repaired the entry
+
+
+def test_foreign_or_mismatched_npz_is_a_miss(tmp_path):
+    x = blobs()
+    cache = ArtifactCache(str(tmp_path))
+    cold = run(x, cache)
+    # a valid npz with the wrong embedded fingerprint (e.g. a file renamed
+    # or collided) must be rejected, deleted, and recomputed
+    path = cache.path(KIND_KNN, cold.knn_fp)
+    np.savez(path, magic="tsne_flink_tpu-artifact-v1",
+             fingerprint="0" * 32, idx=np.zeros((2, 2)),
+             dist=np.zeros((2, 2)))
+    again = run(x, cache)
+    assert again.knn_cache == "cold"
+    np.testing.assert_array_equal(np.asarray(cold.idx), np.asarray(again.idx))
+
+
+def test_missing_required_array_is_a_miss(tmp_path):
+    cache = ArtifactCache(str(tmp_path))
+    cache.save(KIND_KNN, "f" * 32, {"idx": np.arange(4)})  # no 'dist'
+    assert cache.load(KIND_KNN, "f" * 32, ("idx", "dist")) is None
+    assert not os.path.exists(cache.path(KIND_KNN, "f" * 32))
+
+
+def test_data_fingerprint_sensitivity():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    assert data_fingerprint(a) == data_fingerprint(a.copy())
+    assert data_fingerprint(a) != data_fingerprint(a.astype(np.float64))
+    assert data_fingerprint(a) != data_fingerprint(a.reshape(4, 3))
+    b = a.copy()
+    b[0, 1] = np.nextafter(b[0, 1], np.float32(2.0))  # 1-ulp change
+    assert data_fingerprint(a) != data_fingerprint(b)
+
+
+def test_tsne_embed_warm_rerun_bit_identical(tmp_path):
+    """End-to-end through the library pipeline: the SECOND embed of the
+    same (data, plan) must reload prepare from disk and produce the exact
+    same embedding — the optimize loop cannot tell warm from cold."""
+    from tsne_flink_tpu.models.tsne import TsneConfig, tsne_embed
+
+    x = blobs(60)
+    cfg = TsneConfig(iterations=30, perplexity=5.0, repulsion="exact",
+                     row_chunk=16)
+    cache = ArtifactCache(str(tmp_path))
+    y_cold, l_cold = tsne_embed(x, cfg, neighbors=10, artifact_cache=cache)
+    hits0 = cache.hits
+    y_warm, l_warm = tsne_embed(x, cfg, neighbors=10, artifact_cache=cache)
+    assert cache.hits >= hits0 + 2  # knn + affinity both reloaded
+    np.testing.assert_array_equal(np.asarray(y_cold), np.asarray(y_warm))
+    np.testing.assert_array_equal(np.asarray(l_cold), np.asarray(l_warm))
+
+
+def test_spmd_pipeline_prepare_cache_bit_identical(tmp_path):
+    """SpmdPipeline.prepare(): a warm hit skips the sharded kNN/affinity
+    program and returns the exact arrays the cold run produced."""
+    from tsne_flink_tpu.models.tsne import TsneConfig
+    from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+
+    x = blobs(52, 8)
+    cfg = TsneConfig(iterations=20, perplexity=5.0, repulsion="exact",
+                     row_chunk=8)
+    cache = ArtifactCache(str(tmp_path))
+    key = jax.random.key(3)
+
+    def fresh():
+        return SpmdPipeline(cfg, 52, 8, 10, knn_method="bruteforce",
+                            n_devices=8, artifact_cache=cache)
+
+    jidx_c, jval_c, st_c = fresh().prepare(x, key)
+    misses0 = cache.misses
+    pipe = fresh()
+    jidx_w, jval_w, st_w = pipe.prepare(x, key)
+    assert cache.misses == misses0, "second prepare must be a pure hit"
+    np.testing.assert_array_equal(np.asarray(jidx_c), np.asarray(jidx_w))
+    np.testing.assert_array_equal(np.asarray(jval_c), np.asarray(jval_w))
+    for a, b in zip(st_c, st_w):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and a run_checkpointable over the warm prepare matches the uncached
+    # pipeline end to end
+    st1, l1 = fresh().run_checkpointable(x, key)
+    st2, l2 = SpmdPipeline(cfg, 52, 8, 10, knn_method="bruteforce",
+                           n_devices=8).run_checkpointable(x, key)
+    np.testing.assert_array_equal(np.asarray(st1.y), np.asarray(st2.y))
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))

@@ -17,15 +17,20 @@ import subprocess
 import sys
 
 REPO = os.path.join(os.path.dirname(__file__), os.pardir)
-REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data"}
+REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data",
+            "assembly", "cache"}  # self-describing records (ADVICE r5 #1)
 
 
 def run_bench(n, iters, extra_env=None, timeout=600):
-    env = dict(os.environ, TSNE_FORCE_CPU="1", TSNE_BENCH_WRAPPED="1")
+    env = dict(os.environ, TSNE_FORCE_CPU="1", TSNE_BENCH_WRAPPED="1",
+               # hermetic by default: no reads/writes of the repo-local
+               # artifact root (the warm-cache case opts in via extra_env)
+               TSNE_ARTIFACTS="0")
     # hermetic: ambient bench-driver knobs must not steer these cases
     # (each case pins its own deadline clock and knobs via extra_env)
     for knob in ("TSNE_BENCH_T0", "TSNE_BENCH_DEADLINE_S",
-                 "TSNE_BENCH_MARGIN_S", "TSNE_BENCH_SEG"):
+                 "TSNE_BENCH_MARGIN_S", "TSNE_BENCH_SEG",
+                 "TSNE_ARTIFACT_DIR", "TSNE_AFFINITY_ASSEMBLY"):
         env.pop(knob, None)
     env.update(extra_env or {})
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
@@ -70,3 +75,34 @@ def test_deadline_stop_leaves_labeled_extrapolation():
     assert final.get("extrapolated") is True
     assert 0 < final["iterations_run"] < 200
     assert final["measured_seconds"] <= final["value"] * 1.001
+
+
+def test_final_record_carries_resolved_assembly_and_cache():
+    final = run_bench(800, 20)[-1]
+    # the RESOLVED label (affinity_auto's outcome at this shape), never the
+    # requested 'auto' — sorted/split/blocks/auto runs are self-describing
+    assert final["assembly"] in ("sorted", "split", "split-rows", "blocks")
+    assert final["cache"] == "off"  # hermetic default in run_bench
+    assert final["matmul_dtype"] == "float32"  # cpu run: no bf16 default
+
+
+def test_warm_cache_run_is_labeled_and_fast(tmp_path):
+    """Honest cache labeling (the tentpole's bench face): a rerun of the
+    same (n, plan) reloads prepare from the artifact dir, labels itself
+    cache: warm, claims ZERO FLOPs for the loaded stages, and its prepare
+    wall-clock collapses (the 60k acceptance bound is <5%; at this tiny
+    shape disk/dispatch overhead dominates, so pin a loose 50%)."""
+    env = {"TSNE_ARTIFACTS": "1", "TSNE_ARTIFACT_DIR": str(tmp_path)}
+    cold = run_bench(800, 20, env)[-1]
+    assert cold["cache"] == "cold"
+    assert cold["cache_stages"] == {"knn": "cold", "affinities": "cold"}
+    warm = run_bench(800, 20, env)[-1]
+    assert warm["cache"] == "warm"
+    assert warm["cache_stages"] == {"knn": "warm", "affinities": "warm"}
+    assert warm["assembly"] == cold["assembly"]
+    # loaded stages must not claim the arithmetic they skipped
+    assert warm["stage_flops"]["knn"] == 0
+    assert warm["stage_flops"]["affinities"] == 0
+    cold_prep = cold["stages"]["knn"] + cold["stages"]["affinities"]
+    warm_prep = warm["stages"]["knn"] + warm["stages"]["affinities"]
+    assert warm_prep < max(0.5 * cold_prep, 1.0), (warm_prep, cold_prep)

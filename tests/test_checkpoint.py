@@ -48,6 +48,95 @@ def test_load_rejects_foreign_npz(tmp_path):
         ckpt.load(path)
 
 
+def test_v1_file_still_loads(tmp_path):
+    """Back-compat: a checkpoint written by the pre-PR-1 v1 writer (magic
+    v1, no prepare payload) must load exactly as before, and its payload
+    read must answer None (callers then recompute, the old behavior)."""
+    st, _, _ = problem()
+    path = os.path.join(str(tmp_path), "v1.npz")
+    np.savez(path, magic=ckpt.MAGIC_V1, y=np.asarray(st.y),
+             update=np.asarray(st.update), gains=np.asarray(st.gains),
+             next_iter=12, losses=np.asarray([0.5]))
+    st2, it, losses = ckpt.load(path)
+    assert it == 12
+    np.testing.assert_array_equal(st2.y, np.asarray(st.y))
+    assert ckpt.load_prepare(path) is None
+
+
+def test_v2_prepare_payload_roundtrip(tmp_path):
+    """Fat v2 checkpoint: the embedded P arrays round-trip bit-exact and
+    the strings come back as strings."""
+    st, jidx, jval = problem()
+    path = os.path.join(str(tmp_path), "v2.npz")
+    payload = {"affinity_fp": "ab" * 16, "label": "split-rows",
+               "jidx": np.asarray(jidx), "jval": np.asarray(jval)}
+    ckpt.save(path, st, 20, np.asarray([1.0, 2.0]), prepare=payload)
+    # the working-set half is unchanged by the payload
+    st2, it, _ = ckpt.load(path)
+    assert it == 20
+    np.testing.assert_array_equal(st2.y, np.asarray(st.y))
+    got = ckpt.load_prepare(path)
+    assert got["affinity_fp"] == "ab" * 16
+    assert got["label"] == "split-rows"
+    np.testing.assert_array_equal(got["jidx"], np.asarray(jidx))
+    np.testing.assert_array_equal(got["jval"], np.asarray(jval))
+    # a slim v2 (reference only, the CLI's periodic default) works too
+    ckpt.save(path, st, 21, np.asarray([1.0]),
+              prepare={"affinity_fp": "cd" * 16, "label": "sorted"})
+    got = ckpt.load_prepare(path)
+    assert set(got) == {"affinity_fp", "label"}
+
+
+def test_v2_save_rejects_unknown_payload_key(tmp_path):
+    import pytest
+    st, _, _ = problem()
+    with pytest.raises(ValueError, match="unknown prepare payload key"):
+        ckpt.save(os.path.join(str(tmp_path), "x.npz"), st, 0,
+                  np.asarray([0.0]), prepare={"embedding": np.zeros(3)})
+
+
+def test_cli_resume_from_fat_checkpoint_skips_prepare(tmp_path, monkeypatch):
+    """The acceptance contract: resuming from a fat v2 checkpoint runs ZERO
+    kNN / beta-search / symmetrization work — proven by making every such
+    entry point explode and watching the resume succeed anyway."""
+    from tsne_flink_tpu.utils.cli import main
+
+    tmp = str(tmp_path)
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(3, 6)) * 4.0
+    x = centers[rng.integers(0, 3, 40)] + rng.normal(size=(40, 6))
+    inp = os.path.join(tmp, "in.csv")
+    with open(inp, "w") as f:
+        for i in range(40):
+            for j in range(6):
+                f.write(f"{i},{j},{float(x[i, j])!r}\n")
+    ck = os.path.join(tmp, "ck.npz")
+    common = ["--input", inp, "--output", os.path.join(tmp, "out.csv"),
+              "--dimension", "6", "--knnMethod", "bruteforce",
+              "--perplexity", "5", "--dtype", "float64",
+              "--loss", os.path.join(tmp, "l.txt"), "--noCache",
+              "--checkpoint", ck]
+    rc = main(common + ["--iterations", "20", "--fatCheckpoint"])
+    assert rc == 0
+    assert ckpt.load_prepare(ck) is not None
+
+    def boom(*a, **k):
+        raise AssertionError("prepare stage ran on a fat-checkpoint resume")
+
+    import tsne_flink_tpu.ops.affinities as aff
+    import tsne_flink_tpu.ops.knn as knn_mod
+    import tsne_flink_tpu.utils.artifacts as art
+    monkeypatch.setattr(knn_mod, "knn", boom)
+    monkeypatch.setattr(aff, "pairwise_affinities", boom)
+    monkeypatch.setattr(aff, "affinity_auto", boom)
+    monkeypatch.setattr(aff, "affinity_pipeline", boom)
+    monkeypatch.setattr(art, "prepare", boom)
+    rc = main(common + ["--iterations", "40", "--resume", ck])
+    assert rc == 0
+    out = np.loadtxt(os.path.join(tmp, "out.csv"), delimiter=",", ndmin=2)
+    assert out.shape == (40, 3) and np.isfinite(out).all()
+
+
 def test_segmented_run_bit_identical(tmp_path):
     # run 30 iters in one go vs 3 checkpointed segments of 10, incl. a
     # simulated crash+resume from the second checkpoint

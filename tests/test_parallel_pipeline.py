@@ -14,6 +14,7 @@ from jax.sharding import PartitionSpec as P
 from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
 from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
 from tsne_flink_tpu.ops.knn import knn_bruteforce
+from tsne_flink_tpu.utils.compat import shard_map
 from tsne_flink_tpu.parallel.knn import project_knn_sharded, ring_knn
 from tsne_flink_tpu.parallel.mesh import AXIS, make_mesh
 from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
@@ -31,7 +32,7 @@ def shard_run(fn, x, n, n_devices=8, extra_out_specs=None):
     n_padded = -(-n // n_devices) * n_devices
     xp = jnp.pad(jnp.asarray(x), ((0, n_padded - n), (0, 0)))
     out_specs = extra_out_specs or (P(AXIS), P(AXIS))
-    got = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(P(AXIS),),
+    got = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P(AXIS),),
                                 out_specs=out_specs))(xp)
     return tuple(np.asarray(g)[:n] for g in got)
 
@@ -192,7 +193,7 @@ def test_symmetrize_alltoall_matches_replicated():
     jidx_ref, jval_ref = joint_distribution(idx, p, sym_width=s)
 
     mesh = make_mesh(8)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P(AXIS), P(), P(), P())))
@@ -236,7 +237,7 @@ def test_symmetrize_alltoall_reports_capacity_drops():
     idx, dist = knn_bruteforce(jnp.asarray(x), k)
     p = pairwise_affinities(dist, 4.0)
     mesh = make_mesh(8)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s, slack=1),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P(AXIS), P(), P(), P())))
@@ -258,7 +259,7 @@ def test_symmetrize_alltoall_counts_width_overflow():
     idx, dist = knn_bruteforce(jnp.asarray(x), k)
     p = pairwise_affinities(dist, 4.0)
     mesh = make_mesh(8)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda il, pl: symmetrize_alltoall(il, pl, 8, s),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P(AXIS), P(), P(), P())))

@@ -35,7 +35,8 @@ class TSNE:
                  spmd: bool = False, devices: int | None = None,
                  sym_mode: str = "replicated", attraction: str = "auto",
                  dtype: str | None = None,
-                 affinity_assembly: str | None = None):
+                 affinity_assembly: str | None = None,
+                 cache_dir: str | None = None):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -88,6 +89,12 @@ class TSNE:
         # compute dtype for the whole pipeline (the CLI's --dtype): None
         # keeps the input's dtype; "bfloat16" is the MXU-native 2x path
         self.dtype = dtype
+        # opt-in prepare-artifact cache (utils/artifacts.py): kNN graph and
+        # assembled P are content-addressed under this root and reloaded
+        # bit-identically, so repeated fits over the same data/plan (theta
+        # sweeps, backend A/Bs) skip the expensive prepare stage.  None
+        # disables — a LIBRARY must not write to disk unasked.
+        self.cache_dir = cache_dir
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
@@ -129,7 +136,28 @@ class TSNE:
             x = jnp.asarray(x, jnp.dtype(self.dtype))
         else:
             x = jnp.asarray(x)
+            # backend-aware default (VERDICT r5 next-round #3): a defaulted
+            # f32 fit on TPU feeds bf16 matmul operands — quality pinned
+            # indistinguishable, the MXU at 2x.  dtype="float32" pins pure
+            # f32; same restore discipline as the explicit-bf16 branch.
+            from tsne_flink_tpu.ops.metrics import (default_matmul_dtype,
+                                                    matmul_dtype,
+                                                    set_matmul_dtype)
+            md = default_matmul_dtype(compute_dtype=x.dtype)
+            if md is not None:
+                prev = matmul_dtype()
+                set_matmul_dtype(md)
+                try:
+                    return self._fit(x)
+                finally:
+                    set_matmul_dtype(prev)
         return self._fit(x)
+
+    def _artifact_cache(self):
+        if self.cache_dir is None:
+            return None
+        from tsne_flink_tpu.utils.artifacts import ArtifactCache
+        return ArtifactCache(self.cache_dir)
 
     def _fit(self, x) -> "TSNE":
         import jax
@@ -141,12 +169,22 @@ class TSNE:
             n, d = x.shape
             k = (self.neighbors if self.neighbors is not None
                  else 3 * int(cfg.perplexity))
+            cache = self._artifact_cache()
             pipe = SpmdPipeline(cfg, n, d, k, knn_method=self.knn_method,
                                 knn_rounds=self.knn_iterations,
                                 knn_refine=self.knn_refine,
                                 sym_mode=self.sym_mode,
-                                n_devices=self.devices)
-            y, losses = pipe(x, jax.random.key(self.random_state))
+                                n_devices=self.devices,
+                                artifact_cache=cache)
+            if cache is not None and jax.process_count() == 1:
+                # the segmented prepare+optimize form (same results as the
+                # fused program) is the one whose prepare() half the
+                # artifact cache can skip
+                state, losses = pipe.run_checkpointable(
+                    x, jax.random.key(self.random_state))
+                y = state.y
+            else:
+                y, losses = pipe(x, jax.random.key(self.random_state))
             if jax.process_count() > 1:
                 # multi-controller: __call__ returns the PADDED global array
                 # (non-addressable here); gather and slice like the CLI does
@@ -158,7 +196,8 @@ class TSNE:
                 knn_blocks=self.knn_blocks,
                 knn_iterations=self.knn_iterations,
                 knn_refine=self.knn_refine, seed=self.random_state,
-                affinity_assembly=self.affinity_assembly)
+                affinity_assembly=self.affinity_assembly,
+                artifact_cache=self._artifact_cache())
         self.embedding_ = np.asarray(y)
         self.kl_trace_ = np.asarray(losses)
         self.kl_divergence_ = (float(self.kl_trace_[-1])

@@ -35,8 +35,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tsne_flink_tpu.ops.affinities import affinity_pipeline
-from tsne_flink_tpu.ops.knn import knn as knn_dispatch
 from tsne_flink_tpu.ops.metrics import metric_fn
 from tsne_flink_tpu.ops.repulsion_bh import bh_repulsion
 from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
@@ -334,23 +332,24 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
                knn_iterations: int | None = None, knn_refine: int | None = None,
                knn_blocks: int = 8,
                seed: int = 0, sym_width: int | None = None,
-               affinity_assembly: str | None = None):
+               affinity_assembly: str | None = None, artifact_cache=None):
     """Single-device end-to-end pipeline (the ``computeEmbedding`` analog,
     Tsne.scala:105-136): kNN -> β-calibrated affinities -> symmetrized P ->
     init -> optimize.  Returns (embedding [N, m], loss trace).
 
     ``affinity_assembly``: sorted | split ([N, S] builders) | blocks (the
     edge-direct memory-flat layout — at 1M points the hub-widened [N, S]
-    alone exceeds a v5e's HBM).  Default follows TSNE_AFFINITY_ASSEMBLY."""
+    alone exceeds a v5e's HBM).  Default follows TSNE_AFFINITY_ASSEMBLY.
+
+    ``artifact_cache`` (a ``utils/artifacts.ArtifactCache``, or None = off)
+    content-addresses the kNN graph and assembled P on disk: a repeated
+    embed of the same (data, plan) skips straight to the optimize loop,
+    bit-identical to the cold path."""
     cfg = cfg or TsneConfig()
     n = x.shape[0]
     k = neighbors if neighbors is not None else 3 * int(cfg.perplexity)
     key = jax.random.key(seed)
     kkey, ikey = jax.random.split(key)
-    idx, dist = jax.jit(lambda xx: knn_dispatch(
-        xx, k, knn_method, cfg.metric, blocks=knn_blocks,
-        rounds=knn_iterations, refine=knn_refine, key=kkey))(x)
-    state = init_working_set(ikey, n, cfg.n_components, x.dtype)
     if affinity_assembly is None:
         # the docstring's promise: the env default reaches THIS branch too,
         # so TSNE_AFFINITY_ASSEMBLY=blocks gets the real blocks path here
@@ -363,16 +362,17 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
         # an explicit pinned width IS a row-layout request (shape
         # stability / reproducing a prior layout) — auto must not ignore it
         affinity_assembly = "sorted"
-    extra = None
-    if affinity_assembly == "auto":
-        from tsne_flink_tpu.ops.affinities import affinity_auto
-        jidx, jval, extra, _label = affinity_auto(idx, dist, cfg.perplexity)
-    elif affinity_assembly == "blocks":
-        from tsne_flink_tpu.ops.affinities import affinity_blocks
-        jidx, jval, extra = affinity_blocks(idx, dist, cfg.perplexity)
-    else:
-        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity, sym_width,
-                                       assembly=affinity_assembly)
+    # the one shared prepare stage (utils/artifacts.prepare — also the
+    # CLI's and bench's), with the artifact cache layered on top
+    from tsne_flink_tpu.utils.artifacts import prepare as prepare_stage
+    prep = prepare_stage(x, neighbors=k, knn_method=knn_method,
+                         metric=cfg.metric, knn_rounds=knn_iterations,
+                         knn_refine=knn_refine, knn_blocks=knn_blocks,
+                         key=kkey, perplexity=cfg.perplexity,
+                         assembly=affinity_assembly, sym_width=sym_width,
+                         cache=artifact_cache)
+    jidx, jval, extra = prep.jidx, prep.jval, prep.extra_edges
+    state = init_working_set(ikey, n, cfg.n_components, x.dtype)
     if extra is not None:
         # edges_extra must be STATIC (a python-level branch in _gradient)
         run_blocks = jax.jit(partial(optimize, cfg=cfg, edges_extra=True))

@@ -84,7 +84,8 @@ def pairwise_affinities(dist: jnp.ndarray, perplexity: float,
         init = (jnp.asarray(1.0, dtype), jnp.asarray(-jnp.inf, dtype),
                 jnp.asarray(jnp.inf, dtype), jnp.asarray(False))
         if axis_name is not None:
-            init = tuple(lax.pcast(v, axis_name, to="varying") for v in init)
+            from tsne_flink_tpu.utils.compat import pcast
+            init = tuple(pcast(v, axis_name, to="varying") for v in init)
         beta, _, _, _ = lax.fori_loop(0, MAX_BISECT_STEPS, body, init)
         _, p, sum_p = _row_entropy(d_row, valid_row, beta, dtype)
         return p / sum_p

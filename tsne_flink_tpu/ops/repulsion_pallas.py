@@ -69,7 +69,10 @@ def _kernel(rows_ref, cols_ref, wr_ref, wc_ref, off_ref,
 
     @pl.when((pl.program_id(0) == 0) & (j == 0))
     def _():
-        sumq_ref[0, 0] = 0.0
+        # a concrete f32 zero, not the python literal: under x64 (the CPU
+        # interpret-mode test suite) a weak 0.0 is f64 and the legacy state
+        # discharge refuses the f64 -> f32 ref store
+        sumq_ref[0, 0] = jnp.zeros((), sumq_ref.dtype)
 
     sumq_ref[0, 0] += jnp.sum(q)
 

@@ -107,10 +107,11 @@ def ring_knn(x_local: jnp.ndarray, k: int, n_shards: int, n_global: int,
         return best, lax.ppermute(blk, axis_name, shift_left)
 
     # mark the carry as device-varying for shard_map's vma type check
-    init_best = (lax.pcast(jnp.full((nchunks, c, k), jnp.inf, x_local.dtype),
-                           axis_name, to="varying"),
-                 lax.pcast(jnp.zeros((nchunks, c, k), jnp.int32),
-                           axis_name, to="varying"))
+    from tsne_flink_tpu.utils.compat import pcast
+    init_best = (pcast(jnp.full((nchunks, c, k), jnp.inf, x_local.dtype),
+                       axis_name, to="varying"),
+                 pcast(jnp.zeros((nchunks, c, k), jnp.int32),
+                       axis_name, to="varying"))
     # n_shards - 1 hops each fold-then-send; the final received block is
     # folded outside the loop so no shard travels the ring only to be dropped
     best, blk = lax.fori_loop(

@@ -115,8 +115,9 @@ class ShardedOptimizer:
             in_specs = [state_spec, pspec, pspec, pspec, P(), P()]
             if with_edges:
                 in_specs.append((pspec, pspec, pspec))
+            from tsne_flink_tpu.utils.compat import shard_map
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     local_run, mesh=self.mesh,
                     in_specs=tuple(in_specs),
                     out_specs=(state_spec, P()),  # loss trace psum-replicated

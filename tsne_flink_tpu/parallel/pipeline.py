@@ -60,7 +60,7 @@ class SpmdPipeline:
                  knn_refine: int | None = None,
                  sym_width: int | None = None, sym_mode: str = "replicated",
                  sym_slack: int | None = None, sym_strict: bool = False,
-                 n_devices: int | None = None):
+                 n_devices: int | None = None, artifact_cache=None):
         if sym_mode not in ("replicated", "alltoall"):
             raise ValueError(f"sym_mode '{sym_mode}' not defined")
         if knn_method not in ("bruteforce", "partition", "project",
@@ -104,6 +104,13 @@ class SpmdPipeline:
         self._compiled = None
         self._prepared = None
         self._runner = None
+        # utils/artifacts.ArtifactCache (or None): prepare() outputs are
+        # content-addressed on disk, so a resumed / re-benched job skips the
+        # sharded kNN + beta search + symmetrization program entirely.
+        # Single-controller only — multi-controller runs bypass it (their
+        # arrays are non-addressable and the escalation counters must stay
+        # in lockstep across processes).
+        self.artifact_cache = artifact_cache
 
     @property
     def _n_data(self) -> int:
@@ -286,7 +293,8 @@ class SpmdPipeline:
     def _fn(self):
         if self._compiled is None:
             pspec = P(AXIS)
-            self._compiled = jax.jit(jax.shard_map(
+            from tsne_flink_tpu.utils.compat import shard_map
+            self._compiled = jax.jit(shard_map(
                 self._local_fn, mesh=self.mesh,
                 in_specs=(pspec,) * self._n_data + (pspec, P(), P(), P()),
                 out_specs=(pspec, P(), P(), P(), P())))
@@ -412,16 +420,53 @@ class SpmdPipeline:
         if self._prepared is None:
             pspec = P(AXIS)
             state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
-            self._prepared = jax.jit(jax.shard_map(
+            from tsne_flink_tpu.utils.compat import shard_map
+            self._prepared = jax.jit(shard_map(
                 self._prepare_local, mesh=self.mesh,
                 in_specs=(pspec,) * self._n_data + (pspec, P()),
                 out_specs=(pspec, pspec, state_spec, P(), P(), P())))
         return self._prepared
 
+    def _artifact_fp(self, x, key) -> str | None:
+        """Content-address of this pipeline's prepare() outputs: everything
+        they are a deterministic function of, including the escalation
+        POLICY inputs (initial/pinned width + slack) — the escalated result
+        is deterministic in those, so the final artifact is too."""
+        if self.artifact_cache is None or jax.process_count() > 1:
+            return None
+        from tsne_flink_tpu.utils import artifacts as art
+        arrs = x if isinstance(x, tuple) else (x,)
+        return art.fingerprint({
+            "kind": art.KIND_SPMD,
+            "data": "+".join(art.data_fingerprint(a) for a in arrs),
+            "n": self.n, "k": self.k, "method": self.knn_method,
+            "metric": self.cfg.metric,
+            "perplexity": float(self.cfg.perplexity),
+            "rounds": self.knn_rounds, "refine": self.knn_refine,
+            "sym_mode": self.sym_mode,
+            "sym_width": self.sym_width if self._sym_width_pinned else None,
+            "sym_slack": self.sym_slack if self._sym_slack_pinned else None,
+            "sym_strict": self.sym_strict, "devices": self.n_devices,
+            "key": np.asarray(jax.random.key_data(key)).tobytes().hex(),
+            "dtype": str(np.asarray(arrs[-1][:0]).dtype),
+            **art._backend_parts()})
+
     def prepare(self, x, key):
         """Run only the data-prep half (kNN -> P rows -> initial state) as a
         sharded program; returns UNPADDED global (jidx, jval, TsneState) for
-        the segmented / checkpointable optimizer path."""
+        the segmented / checkpointable optimizer path.  With an
+        ``artifact_cache`` the outputs are content-addressed on disk and a
+        hit skips the sharded program entirely, bit-identical."""
+        fp = self._artifact_fp(x, key)
+        if fp is not None:
+            from tsne_flink_tpu.utils import artifacts as art
+            got = self.artifact_cache.load(
+                art.KIND_SPMD, fp, ("jidx", "jval", "y", "update", "gains"))
+            if got is not None:
+                return (jnp.asarray(got["jidx"]), jnp.asarray(got["jval"]),
+                        TsneState(y=jnp.asarray(got["y"]),
+                                  update=jnp.asarray(got["update"]),
+                                  gains=jnp.asarray(got["gains"])))
         while True:
             self._build_prepared()
             *xp, valid = self._pad(x)
@@ -431,9 +476,16 @@ class SpmdPipeline:
                 break
         self._check_dropped(dropped)
         n = self.n
-        return (jidx[:n], jval[:n],
-                TsneState(y=state.y[:n], update=state.update[:n],
-                          gains=state.gains[:n]))
+        out = (jidx[:n], jval[:n],
+               TsneState(y=state.y[:n], update=state.update[:n],
+                         gains=state.gains[:n]))
+        if fp is not None:
+            from tsne_flink_tpu.utils import artifacts as art
+            self.artifact_cache.save(
+                art.KIND_SPMD, fp,
+                {"jidx": out[0], "jval": out[1], "y": out[2].y,
+                 "update": out[2].update, "gains": out[2].gains})
+        return out
 
     def host_state(self, state: TsneState) -> TsneState:
         """PADDED (possibly non-addressable) global state -> UNPADDED host

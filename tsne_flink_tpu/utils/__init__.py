@@ -1,1 +1,3 @@
-"""Host-side utilities: COO CSV I/O, CLI, execution-plan dump, checkpointing."""
+"""Host-side utilities: COO CSV I/O, CLI, execution-plan dump, checkpointing,
+the persistent XLA compilation cache, the prepare-artifact cache
+(``artifacts.py``) and JAX version shims (``compat.py``)."""

@@ -1,4 +1,4 @@
-"""Checkpoint / resume of the optimizer working set.
+"""Checkpoint / resume of the optimizer working set — v2: prepare-aware.
 
 The reference has NO checkpointing — a failed Flink job recomputes everything
 from CSV (SURVEY §5 "Checkpoint / resume: absent").  Here the full working set
@@ -7,6 +7,16 @@ next iteration number, and the partial loss trace are saved as one ``.npz``;
 resuming reproduces the uninterrupted run bit-for-bit because the segmented
 optimizer keys every schedule gate off the absolute iteration
 (``models/tsne.py:optimize``).
+
+v1 carried ONLY the optimizer working set, so a resumed 1.3M-point run
+re-paid the entire 15,723 s prepare stage (VERDICT r5 weak #4) just to
+rebuild a P-matrix that is bit-identical by construction.  v2 additionally
+carries a PREPARE PAYLOAD: always the affinity-artifact fingerprint (see
+``utils/artifacts.py``) and resolved assembly label, and — for "fat"
+checkpoints — the assembled P arrays themselves, so ``--resume`` runs zero
+kNN/β-search/symmetrization work before the first optimize iteration.
+v1 files stay loadable (:func:`load` accepts both magics; their payload is
+simply absent and the caller recomputes, exactly as before).
 """
 
 from __future__ import annotations
@@ -18,12 +28,31 @@ import numpy as np
 
 from tsne_flink_tpu.models.tsne import TsneState
 
-MAGIC = "tsne_flink_tpu-ckpt-v1"
+MAGIC_V1 = "tsne_flink_tpu-ckpt-v1"
+MAGIC = "tsne_flink_tpu-ckpt-v2"
+_MAGICS = (MAGIC_V1, MAGIC)
+
+#: array names a prepare payload may carry (stored with a ``prep_`` prefix
+#: so they can never collide with working-set keys).  ``affinity_fp`` and
+#: ``label`` are strings; the rest are the artifact arrays themselves
+#: (``jidx``/``jval`` plus the blocks triple when label == "blocks").
+PREPARE_KEYS = ("affinity_fp", "label", "jidx", "jval",
+                "rsrc", "rdst", "rval")
 
 
 def save(path: str, state: TsneState, next_iter: int,
-         losses: np.ndarray) -> None:
-    """Atomic write (tmp + rename) so an interrupt never corrupts the file."""
+         losses: np.ndarray, prepare: dict | None = None) -> None:
+    """Atomic write (tmp + rename) so an interrupt never corrupts the file.
+
+    ``prepare`` (optional) is the v2 payload dict — any subset of
+    :data:`PREPARE_KEYS`; pass the artifact arrays too for a fat checkpoint
+    whose resume needs no artifact cache at all."""
+    extras = {}
+    for k, v in (prepare or {}).items():
+        if k not in PREPARE_KEYS:
+            raise ValueError(f"unknown prepare payload key '{k}' "
+                             f"({' | '.join(PREPARE_KEYS)})")
+        extras["prep_" + k] = np.asarray(v)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
@@ -32,7 +61,8 @@ def save(path: str, state: TsneState, next_iter: int,
             np.savez(f, magic=MAGIC, y=np.asarray(state.y),
                      update=np.asarray(state.update),
                      gains=np.asarray(state.gains),
-                     next_iter=int(next_iter), losses=np.asarray(losses))
+                     next_iter=int(next_iter), losses=np.asarray(losses),
+                     **extras)
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -44,13 +74,36 @@ class NotACheckpoint(ValueError):
 
 
 def load(path: str):
-    """Returns (TsneState (numpy arrays), next_iter, losses)."""
+    """Returns (TsneState (numpy arrays), next_iter, losses) — v1 AND v2
+    files (the prepare payload, if any, is read by :func:`load_prepare`)."""
     try:
         with np.load(path) as z:
-            if str(z["magic"]) != MAGIC:
+            if str(z["magic"]) not in _MAGICS:
                 raise NotACheckpoint(f"{path} is not a tsne_flink_tpu checkpoint")
             state = TsneState(y=z["y"], update=z["update"], gains=z["gains"])
             return state, int(z["next_iter"]), z["losses"]
+    except NotACheckpoint:
+        raise
+    except (ValueError, KeyError, OSError) as e:
+        raise NotACheckpoint(
+            f"{path} is not a tsne_flink_tpu checkpoint ({e})") from e
+
+
+def load_prepare(path: str) -> dict | None:
+    """The v2 prepare payload of ``path`` as a dict (strings for
+    ``affinity_fp``/``label``, numpy arrays otherwise), or None for a v1
+    file / a v2 file saved without one."""
+    try:
+        with np.load(path) as z:
+            if str(z["magic"]) not in _MAGICS:
+                raise NotACheckpoint(f"{path} is not a tsne_flink_tpu checkpoint")
+            out = {}
+            for k in PREPARE_KEYS:
+                name = "prep_" + k
+                if name in z.files:
+                    v = z[name]
+                    out[k] = str(v) if v.dtype.kind == "U" else v
+            return out or None
     except NotACheckpoint:
         raise
     except (ValueError, KeyError, OSError) as e:

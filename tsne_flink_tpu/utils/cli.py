@@ -92,14 +92,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="BH acceptance test: vdm = side/sqrt(D) < theta "
                         "(scale-free, accurate); flink = the reference's "
                         "halfwidth/D < theta (QuadTree.scala:134)")
-    p.add_argument("--dtype", default="float32",
+    p.add_argument("--dtype", default=None,
                    choices=["float32", "float64", "bfloat16"],
-                   help="float32 (default, accuracy reference), float64 "
-                        "(CPU golden runs), or bfloat16 — MIXED precision: "
-                        "bf16 distance-matmul operands (the MXU's 2x rate), "
-                        "f32 state/accumulations/affinities.  (An all-bf16 "
+                   help="float32 (accuracy reference), float64 (CPU golden "
+                        "runs), or bfloat16 — MIXED precision: bf16 "
+                        "distance-matmul operands (the MXU's 2x rate), f32 "
+                        "state/accumulations/affinities.  (An all-bf16 "
                         "pipeline is measurably fatal — 8-bit mantissa "
-                        "breaks the beta bisection; results/quality_bf16)")
+                        "breaks the beta bisection; results/quality_bf16.) "
+                        "Default: f32 compute, and on the TPU backend the "
+                        "bf16 matmul operands come for free (quality pinned "
+                        "indistinguishable); pass --dtype float32 "
+                        "explicitly to pin pure-f32 matmuls")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size over the point axis (default: all)")
     p.add_argument("--symWidth", type=int, default=None,
@@ -131,9 +135,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "single-device; required once N outgrows one chip")
     p.add_argument("--checkpoint", default=None,
                    help="path prefix for periodic (y, update, gains, iter) "
-                        "checkpoints — capability-add over the reference")
+                        "checkpoints — capability-add over the reference. "
+                        "v2 files also carry the prepare-artifact "
+                        "fingerprint so --resume can skip kNN/affinities")
     p.add_argument("--checkpointEvery", type=int, default=0)
     p.add_argument("--resume", default=None)
+    p.add_argument("--fatCheckpoint", action="store_true",
+                   help="embed the assembled P arrays in every checkpoint "
+                        "(larger files) so --resume skips the whole prepare "
+                        "stage even without the artifact cache")
+    p.add_argument("--cacheDir", default=None,
+                   help="prepare-artifact cache root (kNN graph + assembled "
+                        "P, content-addressed .npz; utils/artifacts.py). "
+                        "Default: $TSNE_ARTIFACT_DIR, else the repo-local "
+                        ".tsne_artifacts.  An explicit --cacheDir enables "
+                        "the cache even when $TSNE_ARTIFACTS=0")
+    p.add_argument("--noCache", action="store_true",
+                   help="disable the prepare-artifact cache (always "
+                        "recompute kNN + affinities); $TSNE_ARTIFACTS=0 "
+                        "sets the same default")
     p.add_argument("--profile", default=None,
                    help="jax.profiler trace directory")
     # multi-host bring-up (jax.distributed over DCN — the analog of the
@@ -153,11 +173,27 @@ def pick_knn_rounds(n: int) -> int:
     return _p(n)
 
 
+#: auto exact/approximate crossover per backend (VERDICT r5 next-round #2):
+#: the fused exact repulsion on TPU measured 151.2 s vs fft's 217.8 s at
+#: n=60k (round-5 backend A/B), so exact stays the auto choice to ~100k
+#: rows there; every other backend keeps the 32k crossover the tiled CPU
+#: sweep measured.
+EXACT_N_MAX = {"tpu": 100_000}
+EXACT_N_MAX_DEFAULT = 32_768
+
+
 def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2,
-                   theta_explicit: bool = False) -> str:
+                   theta_explicit: bool = False,
+                   backend: str | None = None) -> str:
     """auto: exact for small N / theta=0 (the oracle-exact regime); FFT
     interpolation for large N (measured ~1e-4 force error at the default grid,
     far tighter than BH at any practical theta, and the fastest path on TPU).
+
+    "Small N" is backend-aware (:data:`EXACT_N_MAX`): the TPU's fused exact
+    kernel beats fft to ~100k rows, so the 60k headline workload runs exact
+    there while CPU keeps its measured 32k crossover.  ``backend=None``
+    resolves ``jax.default_backend()`` at call time; pass it explicitly in
+    tests.
 
     An EXPLICITLY passed nonzero theta routes auto to ``bh`` at large N — a
     user who sets the BH knob is asking for theta-gated Barnes-Hut semantics
@@ -171,7 +207,10 @@ def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2,
     natively."""
     if mode != "auto":
         return mode
-    if theta == 0.0 or n <= 32768:
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    if theta == 0.0 or n <= EXACT_N_MAX.get(backend, EXACT_N_MAX_DEFAULT):
         return "exact"
     if n_components not in (2, 3):
         return "exact"  # bh/fft are 2-D/3-D only; exact handles any m
@@ -181,24 +220,27 @@ def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2,
 
 
 def _load_resume(args, dtype):
-    """(start_iter, loss_carry, TsneState|None) from --resume, shared by the
-    host-staged and --spmd branches."""
+    """(start_iter, loss_carry, TsneState|None, prepare_payload|None) from
+    --resume, shared by the host-staged and --spmd branches.  The payload is
+    a v2 checkpoint's embedded prepare artifacts (utils/checkpoint.py);
+    v1 files simply return None there and the caller recomputes."""
     import jax.numpy as jnp
 
     from tsne_flink_tpu.models.tsne import TsneState
     from tsne_flink_tpu.utils import checkpoint as ckpt
 
     if not args.resume:
-        return 0, None, None
+        return 0, None, None, None
     st_np, start_iter, loss_carry = ckpt.load(args.resume)
     state = TsneState(y=jnp.asarray(st_np.y, dtype),
                       update=jnp.asarray(st_np.update, dtype),
                       gains=jnp.asarray(st_np.gains, dtype))
+    payload = ckpt.load_prepare(args.resume)
     print(f"resumed from {args.resume} at iteration {start_iter}")
-    return start_iter, loss_carry, state
+    return start_iter, loss_carry, state, payload
 
 
-def _make_checkpoint_cb(args):
+def _make_checkpoint_cb(args, prepare_payload=None):
     """Periodic-checkpoint callback for --checkpoint/--checkpointEvery."""
     if not (args.checkpoint and args.checkpointEvery > 0):
         return None
@@ -207,17 +249,20 @@ def _make_checkpoint_cb(args):
     from tsne_flink_tpu.utils import checkpoint as ckpt
 
     def cb(st, next_iter, losses):
-        ckpt.save(args.checkpoint, st, next_iter, np.asarray(losses))
+        ckpt.save(args.checkpoint, st, next_iter, np.asarray(losses),
+                  prepare=prepare_payload)
     return cb
 
 
-def _save_final_checkpoint(args, state, iterations, losses):
+def _save_final_checkpoint(args, state, iterations, losses,
+                           prepare_payload=None):
     if not args.checkpoint:
         return
     import numpy as np
 
     from tsne_flink_tpu.utils import checkpoint as ckpt
-    ckpt.save(args.checkpoint, state, iterations, np.asarray(losses))
+    ckpt.save(args.checkpoint, state, iterations, np.asarray(losses),
+              prepare=prepare_payload)
 
 
 def main(argv=None) -> int:
@@ -273,19 +318,33 @@ def _main(argv=None) -> int:
     import numpy as np
 
     from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
-    from tsne_flink_tpu.ops.affinities import affinity_pipeline
-    from tsne_flink_tpu.ops.knn import knn as knn_dispatch
     from tsne_flink_tpu.utils import io as tio
     from tsne_flink_tpu.parallel.mesh import shard_pipeline
 
     # resolve the assembly BEFORE the input parse and kNN stages: an
     # unsupported combination (or an env typo) must fail in milliseconds,
     # not after minutes of chip time (code-review r5, twice)
+    if args.affinityAssembly is not None and args.spmd:
+        # mirror models/api.py (ADVICE r5 #2): the spmd pipeline symmetrizes
+        # with its own replicated/alltoall strategies (--symMode), so ANY
+        # explicit assembly override — not just blocks — would be dropped on
+        # the floor and a CLI builder A/B under --spmd would silently
+        # measure the wrong path.  Refuse instead.
+        raise SystemExit(f"--affinityAssembly {args.affinityAssembly} has "
+                         "no effect with --spmd (symmetrization is chosen "
+                         "by --symMode there); drop the flag")
     assembly = (args.affinityAssembly
                 or os.environ.get("TSNE_AFFINITY_ASSEMBLY", "auto"))
     if assembly not in ("auto", "sorted", "split", "blocks"):
         raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
                          "(auto | sorted | split | blocks)")
+    if assembly in ("sorted", "split") and args.spmd:
+        # env-sourced override: same no-effect situation, but an ambient env
+        # var should not kill a job — warn loudly instead (blocks still
+        # refuses below: an env user asked for a layout spmd cannot run)
+        print(f"# TSNE_AFFINITY_ASSEMBLY={assembly} is ignored with --spmd "
+              "(symmetrization is chosen by --symMode)", file=sys.stderr)
+        assembly = "auto"
     if assembly == "auto" and args.executionPlan:
         # the plan dump wants a lowerable rows program, and auto's choice
         # is data-dependent (post-kNN) — resolve NOW, per the fail-fast
@@ -311,6 +370,8 @@ def _main(argv=None) -> int:
                              "on non-addressable multi-controller arrays)")
 
     t0 = time.time()
+    dtype_explicit = args.dtype is not None
+    args.dtype = args.dtype or "float32"
     if args.dtype == "bfloat16":
         # MIXED precision, the MXU-native contract: bf16 feeds the distance
         # matmuls (2x systolic rate), every accumulation / affinity /
@@ -322,6 +383,18 @@ def _main(argv=None) -> int:
         dtype = jnp.dtype(jnp.float32)
     else:
         dtype = jnp.dtype(args.dtype)
+        if not dtype_explicit:
+            # backend-aware default (VERDICT r5 next-round #3): a defaulted
+            # f32 run on TPU feeds bf16 matmul operands — quality pinned
+            # indistinguishable, MXU at 2x; --dtype float32 pins pure f32
+            from tsne_flink_tpu.ops.metrics import (default_matmul_dtype,
+                                                    set_matmul_dtype)
+            md = default_matmul_dtype(compute_dtype=dtype)
+            if md is not None:
+                set_matmul_dtype(md)
+                print("# TPU backend: defaulting f32 run to bf16 matmul "
+                      "operands (pass --dtype float32 to pin pure f32)",
+                      file=sys.stderr)
     if jax.default_backend() == "tpu" and args.dtype != "float64":
         # warm the one-time Mosaic lowering probe OUTSIDE any trace, so the
         # in-trace exact_impl=auto decision is a pure cache read
@@ -329,6 +402,16 @@ def _main(argv=None) -> int:
         mosaic_supported()
     neighbors = (args.neighbors if args.neighbors is not None
                  else 3 * int(args.perplexity))
+
+    # ---- prepare-artifact cache (utils/artifacts.py): kNN graph and
+    # assembled P are content-addressed on disk and transparently reloaded,
+    # so only the FIRST run of a (data, plan) pays the prepare stage.
+    # An explicit --cacheDir re-enables over $TSNE_ARTIFACTS=0.
+    from tsne_flink_tpu.utils import artifacts as art
+    env_off = os.environ.get("TSNE_ARTIFACTS", "1").lower() in ("0", "false")
+    art_cache = None
+    if not args.noCache and (args.cacheDir is not None or not env_off):
+        art_cache = art.ArtifactCache(args.cacheDir)
 
     key = jax.random.key(args.randomState)
     if args.inputDistanceMatrix:
@@ -349,13 +432,6 @@ def _main(argv=None) -> int:
         x = jnp.asarray(x_np, dtype)
         spmd_data = x
         spmd_knn_method = args.knnMethod
-        if not args.spmd:
-            idx, dist = jax.jit(
-                lambda xx: knn_dispatch(
-                    xx, neighbors, args.knnMethod, args.metric,
-                    blocks=args.knnBlocks or jax.device_count(),
-                    rounds=args.knnIterations, refine=args.knnRefine,
-                    key=key))(x)
 
     cfg = TsneConfig(
         n_components=args.nComponents,
@@ -385,7 +461,8 @@ def _main(argv=None) -> int:
                             sym_width=args.symWidth, sym_mode=args.symMode,
                             sym_slack=args.symSlack,
                             sym_strict=args.symStrict,
-                            n_devices=args.devices)
+                            n_devices=args.devices,
+                            artifact_cache=art_cache)
         if args.executionPlan:
             lowered = pipe.lower(spmd_data, key)
             plan = {
@@ -402,7 +479,8 @@ def _main(argv=None) -> int:
         if args.profile:
             jax.profiler.start_trace(args.profile)
         if args.resume or args.checkpoint:
-            start_iter, loss_carry, resume_state = _load_resume(args, dtype)
+            start_iter, loss_carry, resume_state, _ = _load_resume(args,
+                                                                   dtype)
             state, losses = pipe.run_checkpointable(
                 spmd_data, key, start_iter=start_iter, loss_carry=loss_carry,
                 resume_state=resume_state,
@@ -441,19 +519,64 @@ def _main(argv=None) -> int:
               f"{pipe.n_devices} device(s), backend={jax.default_backend()})")
         return 0
 
-    extra_edges = None
-    if assembly == "auto":  # executionPlan runs resolved to sorted above
-        from tsne_flink_tpu.ops.affinities import affinity_auto
-        jidx, jval, extra_edges, label = affinity_auto(idx, dist,
-                                                       cfg.perplexity)
-    elif assembly == "blocks":
-        from tsne_flink_tpu.ops.affinities import affinity_blocks
-        jidx, jval, extra_edges = affinity_blocks(idx, dist, cfg.perplexity)
-    else:
-        jidx, jval = affinity_pipeline(idx, dist, cfg.perplexity,
-                                       assembly=assembly)
+    # ---- prepare stage (kNN -> beta search -> assembled P), shared with
+    # bench.py / tsne_embed via utils/artifacts.prepare and artifact-cached;
+    # a v2 fat checkpoint skips it entirely
+    start_iter, loss_carry, state, prep_payload = _load_resume(args, dtype)
 
-    start_iter, loss_carry, state = _load_resume(args, dtype)
+    prep_kwargs = dict(
+        neighbors=neighbors, knn_method=args.knnMethod, metric=args.metric,
+        knn_rounds=args.knnIterations, knn_refine=args.knnRefine,
+        knn_blocks=args.knnBlocks or jax.device_count(), key=key,
+        perplexity=cfg.perplexity, assembly=assembly)
+    if args.inputDistanceMatrix:
+        prep_kwargs["knn"] = (idx, dist)
+    else:
+        prep_kwargs["x"] = x
+
+    jidx = jval = extra_edges = None
+    label = affinity_fp = None
+    if prep_payload is not None and "jidx" in prep_payload:
+        # fat v2 checkpoint: validate its fingerprint against THIS run's
+        # inputs/plan, then skip kNN + beta search + symmetrization outright
+        _, want_fp = art.prepare_fingerprints(**prep_kwargs)
+        have_fp = prep_payload.get("affinity_fp")
+        if have_fp is not None and have_fp != want_fp:
+            print(f"WARNING: checkpoint prepare payload ({have_fp}) does "
+                  f"not match this run's data/plan ({want_fp}); "
+                  "recomputing prepare", file=sys.stderr)
+        else:
+            label = prep_payload.get("label", "sorted")
+            jidx = jnp.asarray(prep_payload["jidx"])
+            jval = jnp.asarray(prep_payload["jval"])
+            if label == "blocks":
+                extra_edges = tuple(jnp.asarray(prep_payload[nm])
+                                    for nm in ("rsrc", "rdst", "rval"))
+            affinity_fp = have_fp or want_fp
+            print("# prepare: skipped (embedded in v2 checkpoint)",
+                  file=sys.stderr)
+    if jidx is None:
+        prep = art.prepare(cache=art_cache, **prep_kwargs)
+        jidx, jval = prep.jidx, prep.jval
+        extra_edges, label = prep.extra_edges, prep.label
+        affinity_fp = prep.affinity_fp
+        print(f"# prepare: knn {prep.knn_seconds:.2f}s ({prep.knn_cache}) "
+              f"affinities {prep.affinity_seconds:.2f}s "
+              f"({prep.affinity_cache}) assembly={label}", file=sys.stderr)
+
+    # v2 checkpoints carry the prepare provenance; --fatCheckpoint embeds
+    # the arrays themselves so a resume needs neither cache nor recompute
+    save_payload = {"label": label}
+    if affinity_fp is None and (args.checkpoint and args.fatCheckpoint):
+        _, affinity_fp = art.prepare_fingerprints(**prep_kwargs)
+    if affinity_fp is not None:
+        save_payload["affinity_fp"] = affinity_fp
+    if args.fatCheckpoint:
+        save_payload.update(jidx=jidx, jval=jval)
+        if extra_edges is not None:
+            save_payload.update(rsrc=extra_edges[0], rdst=extra_edges[1],
+                                rval=extra_edges[2])
+
     if state is None:
         state = init_working_set(jax.random.key(args.randomState), n,
                                  cfg.n_components, dtype)
@@ -479,12 +602,13 @@ def _main(argv=None) -> int:
     state, losses = runner(state, jidx, jval, start_iter=start_iter,
                            loss_carry=loss_carry,
                            checkpoint_every=args.checkpointEvery,
-                           checkpoint_cb=_make_checkpoint_cb(args),
+                           checkpoint_cb=_make_checkpoint_cb(args,
+                                                             save_payload),
                            extra_edges=extra_edges)
     state.y.block_until_ready()
     if args.profile:
         jax.profiler.stop_trace()
-    _save_final_checkpoint(args, state, cfg.iterations, losses)
+    _save_final_checkpoint(args, state, cfg.iterations, losses, save_payload)
 
     tio.write_embedding(args.output, ids, np.asarray(state.y[:n]))
     tio.write_loss(args.loss, np.asarray(losses))

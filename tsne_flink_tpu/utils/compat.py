@@ -1,0 +1,36 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current ``jax.shard_map`` / ``lax.pcast`` surface;
+the container image ships jax 0.4.37, where shard_map still lives in
+``jax.experimental.shard_map`` and the vma (varying-manual-axes) type
+system — and with it ``pcast`` — does not exist yet.  Everything routes
+through these two wrappers so a jax upgrade is a no-op and a downgrade is
+one module, not a source sweep.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` where available, else the experimental spelling.
+
+    ``check_vma``/``check_rep`` is disabled on the legacy path: the
+    replication checker there predates the device-varying annotations this
+    code carries (see :func:`pcast`) and rejects valid programs."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as legacy
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def pcast(x, axis_name, to="varying"):
+    """``lax.pcast`` where the vma type system exists; identity before it
+    (values are unchanged either way — pcast only adjusts the type)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    return x
