@@ -162,13 +162,40 @@ def _run_with_retries():
         # because this was opt-in).  The JSON carries backend=cpu + an MFU
         # against a nominal CPU peak, so it can never be mistaken for a TPU
         # number.  Set TSNE_BENCH_CPU_FALLBACK=0 to fail hard instead.
+        # TSNE_TUNNEL_DOWN makes the fallback records carry an explicit
+        # tunnel_down marker + the latest mirrored on-chip record's path
+        # (VERDICT r5 item 9: a driver-window outage must not silently
+        # present a CPU fallback as the round's number).
         print("# accelerator unavailable after retries — CPU fallback "
-              "(JSON will carry backend=cpu)", file=sys.stderr)
+              "(JSON will carry backend=cpu + tunnel_down marker)",
+              file=sys.stderr)
         env["TSNE_FORCE_CPU"] = "1"
+        env["TSNE_TUNNEL_DOWN"] = "1"
         sys.exit(subprocess.run(
             [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
             env=env).returncode)
     sys.exit(3)
+
+
+def _latest_tpu_record():
+    """Path of the newest committed results/*.json whose record says
+    backend=tpu — the mirrored on-chip evidence a tunnel-down fallback
+    record points at so the round's real number is one hop away."""
+    import glob
+    best = None
+    for path in glob.glob(os.path.join("results", "*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        recs = rec if isinstance(rec, list) else [rec]
+        if any(isinstance(r, dict) and r.get("backend") == "tpu"
+               for r in recs):
+            mt = os.path.getmtime(path)
+            if best is None or mt > best[0]:
+                best = (mt, path)
+    return best[1] if best else None
 
 
 class _DeadlineStop(Exception):
@@ -276,11 +303,17 @@ def main():
     # so every partial record can scale the unmeasured remainder by the
     # measured FLOP rate, and the record is grade-ready the moment any
     # wall-clock lands, on whatever backend actually ran
+    from tsne_flink_tpu.ops.knn_tiles import pick_knn_tiles
     from tsne_flink_tpu.utils.flops import (
-        affinity_flops, knn_flops, optimize_flops, peak_flops)
+        affinity_flops, knn_substage_flops, optimize_flops, peak_flops)
     backend = jax.default_backend()
-    f_knn = knn_flops(n, d_in, k, "project", rounds=rounds,
-                      refine_rounds=refine)
+    # the tile plan the prepare stage will resolve (same model; autotune,
+    # when enabled, overrides and the record is updated after prepare)
+    tile_plan = pick_knn_tiles(n, d_in, k, backend)
+    f_knn_sub = knn_substage_flops(n, d_in, k, rounds=rounds,
+                                   block=tile_plan.block,
+                                   refine_rounds=refine)
+    f_knn = float(sum(f_knn_sub.values()))
     f_aff = affinity_flops(n, k)
     kind = jax.devices()[0].device_kind if backend == "tpu" else ""
     peak, basis = peak_flops(backend, kind, jax.device_count())
@@ -299,7 +332,17 @@ def main():
         "assembly": assembly,
         "cache": "off" if art_cache is None else "cold",
         "matmul_dtype": matmul_label,
+        # resolved kNN tile plan (ops/knn_tiles) — updated after prepare if
+        # autotune overrode the model; deliberately NOT in the artifact
+        # fingerprint (recall is pinned, not bit-identity across plans)
+        "knn_tiles": tile_plan.as_record(),
     }
+    if os.environ.get("TSNE_TUNNEL_DOWN", "") not in ("", "0"):
+        # VERDICT r5 item 9: the TPU backend was probed first and did not
+        # answer — label every record of this fallback run and point at
+        # the latest mirrored on-chip evidence
+        base["tunnel_down"] = True
+        base["last_tpu_record"] = _latest_tpu_record()
 
     def emit_partial(measured_s, est_total_s, stages, note):
         est = max(float(est_total_s), float(measured_s))
@@ -341,12 +384,17 @@ def main():
                          knn_rounds=rounds, knn_refine=refine,
                          key=jax.random.key(0), perplexity=cfg.perplexity,
                          assembly=assembly, cache=art_cache,
-                         on_stage=on_stage)
+                         on_stage=on_stage,
+                         knn_autotune=os.environ.get(
+                             "TSNE_KNN_AUTOTUNE", "") not in ("", "0"))
     t_knn, t_aff = prep.knn_seconds, prep.affinity_seconds
     jidx, jval, extra = prep.jidx, prep.jval, prep.extra_edges
     label = prep.label
     base["assembly"] = label   # the record reports what actually ran
     base["cache"] = prep.cache_label
+    if prep.knn_tiles is not None:
+        base["knn_tiles"] = prep.knn_tiles  # what actually ran (autotune)
+    knn_substages = prep.knn_substages  # measured per-substage seconds
     f_knn_run = 0.0 if prep.knn_cache == "warm" else f_knn
     f_aff_run = 0.0 if prep.affinity_cache == "warm" else f_aff
 
@@ -448,16 +496,25 @@ def main():
                                               else f_opt_done)
     # MFU from MEASURED work over MEASURED time — extrapolation cancels out
     mfu = round(measured_flops / (measured_s * peak), 5) if peak else None
+    stages_rec = {"knn": round(t_knn, 3), "affinities": round(t_aff, 3),
+                  "optimize": round(t_opt, 3)}
+    if knn_substages:
+        # measured per-substage seconds from the decomposed cold run (the
+        # round-6 observability contract: the next on-chip window
+        # attributes the kNN stage on evidence, not hypothesis)
+        stages_rec["knn_substages"] = knn_substages
     rec = {**base,
            "value": round(total, 3),
            "vs_baseline": round(10.0 / total, 3),
-           "stages": {"knn": round(t_knn, 3), "affinities": round(t_aff, 3),
-                      "optimize": round(t_opt, 3)},
+           "stages": stages_rec,
            # stage_flops pairs with the MEASURED "stages" seconds, so an
            # extrapolated record carries the partial-run optimize FLOPs
            # (full-workload FLOPs live in "flops", matching "value")
            "stage_flops": {"knn": f_knn_run, "affinities": f_aff_run,
-                           "optimize": f_opt if complete else f_opt_done},
+                           "optimize": f_opt if complete else f_opt_done,
+                           "knn_substages":
+                               f_knn_sub if f_knn_run else
+                               {kk: 0.0 for kk in f_knn_sub}},
            "flops": flops, "mfu": mfu,
            "cache_stages": {"knn": prep.knn_cache,
                             "affinities": prep.affinity_cache},

@@ -1,0 +1,273 @@
+"""Per-substage kNN profile: measured seconds vs modeled FLOPs/bytes.
+
+The round-5 on-chip window left kNN as the largest unexplained line:
+~27 s at ~0.04% of peak on one chip, 379.9 s of the 515.8 s 60k CPU bench
+(BENCH_r05.json) — with no attribution below the stage total.  This
+script produces that attribution as machine-readable JSON so the next
+on-chip window argues from evidence:
+
+* COARSE: the real auto hybrid plan, run decomposed through
+  ``ops/knn.knn(on_substage=...)`` — the exact per-stage wall-clock the
+  prepare stage records (zorder_seed | zorder_cycles | merge | refine).
+* FINE: one refine round's internals re-run stage by stage at the true
+  funnel widths (gateway build, JL filter, cascade, full-dim rerank,
+  merge; plus zorder_sort vs band_rerank inside a Z-round), each timed
+  with ``block_until_ready``.  Labeled ``fine`` because the stage
+  boundaries force materialization the fused pipeline may avoid —
+  attribution, not an end-to-end claim.
+* DEDUP A/B: the full-dim rerank gather timed in both forms (direct
+  [c, Z, d] gather vs ``_compact_gather``'s fetch-each-unique-row-once)
+  — the committed evidence behind ``dedup_gather``'s backend policy.
+* MODEL: ``utils/flops.knn_substage_flops`` / ``knn_substage_bytes`` at
+  the same shape, so measured seconds pair with modeled arithmetic
+  intensity line by line.
+
+Every line printed to stdout is a standalone JSON record; the final
+aggregate also lands in ``--out`` (default
+``results/profile_knn_<backend>.json``).
+
+Usage:
+  python scripts/profile_knn.py [N] [D] [K] [--smoke] [--reps R]
+                                [--out PATH] [--no-fine]
+
+``--smoke``: a seconds-scale shape (n=1024, d=320) that still exercises
+the cascade funnel — exercised by one tier-1 test.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", nargs="?", type=int, default=60_000)
+    ap.add_argument("d", nargs="?", type=int, default=784)
+    ap.add_argument("k", nargs="?", type=int, default=90)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape (n=1024 d=320 k=30), one cycle")
+    ap.add_argument("--reps", type=int, default=1)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--no-fine", action="store_true",
+                    help="skip the fine-stage re-run (coarse + model only)")
+    args = ap.parse_args(argv)
+
+    import jax
+    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bench import make_data
+    from tsne_flink_tpu.ops import knn as K
+    from tsne_flink_tpu.ops.knn_tiles import pick_knn_tiles
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    from tsne_flink_tpu.utils.flops import (_funnel_widths,
+                                            knn_substage_bytes,
+                                            knn_substage_flops)
+    enable_compilation_cache()
+
+    if args.smoke:
+        # tiny but funnel-exercising: d=320 engages the JL filter
+        # (pick_knn_filter) and one forced cycle runs the whole refine
+        # path the auto policy would skip at this n
+        n, d, k = 1024, 320, 30
+        rounds, cycles = 2, 1
+    else:
+        n, d, k = args.n, args.d, args.k
+        rounds = K.pick_knn_rounds(n)
+        cycles = K.pick_knn_refine(n, d)
+    backend = jax.default_backend()
+    tiles = pick_knn_tiles(n, d, k, backend)
+    rec = {"metric": "knn_substage_profile", "backend": backend,
+           "n": n, "d": d, "k": k, "rounds": rounds, "refine": cycles,
+           "tiles": tiles.as_record(), "smoke": bool(args.smoke)}
+
+    def emit(stage, payload):
+        print(json.dumps({"stage": stage, **payload}), flush=True)
+
+    x = jnp.asarray(make_data(n, d))
+
+    # ---- coarse: the real plan, decomposed (what prepare records) ----
+    subs = {}
+    t0 = time.time()
+    idx, dist = K.knn(x, k, "project", rounds=rounds, refine=cycles,
+                      key=jax.random.key(0), tiles=tiles,
+                      on_substage=subs.update)
+    jax.block_until_ready(dist)
+    rec["coarse"] = {kk: round(v, 3) for kk, v in subs.items()}
+    rec["coarse"]["total"] = round(time.time() - t0, 3)
+    emit("coarse", rec["coarse"])
+
+    # ---- analytic model at the same shape ----
+    rec["model_flops"] = knn_substage_flops(
+        n, d, k, rounds=rounds, block=tiles.block, refine_rounds=cycles)
+    rec["model_bytes"] = knn_substage_bytes(
+        n, d, k, rounds=rounds, block=tiles.block, refine_rounds=cycles)
+    emit("model", {"flops": rec["model_flops"], "bytes": rec["model_bytes"]})
+
+    if not args.no_fine and cycles > 0:
+        rec["fine"] = fine_stages(jax, jnp, lax, K, x, idx, dist, k, tiles,
+                                  args.reps, emit)
+
+    out = args.out or os.path.join(
+        os.path.dirname(__file__), "..", "results",
+        f"profile_knn_{backend}{'_smoke' if args.smoke else ''}.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps({"stage": "written", "path": os.path.relpath(out)}),
+          flush=True)
+    return 0
+
+
+def fine_stages(jax, jnp, lax, K, x, idx, dist, k, tiles, reps, emit):
+    """One refine round's internals, stage by stage at the true funnel
+    widths (mirrored from ops/knn via utils/flops._funnel_widths)."""
+    from functools import partial
+
+    from tsne_flink_tpu.utils.flops import _funnel_widths
+
+    n, d = int(x.shape[0]), int(x.shape[1])
+    s = min(8, k)
+    cand_w, fd, cd, keep, keep2, ke = _funnel_widths(d, k, 8)
+    c = min(tiles.refine_chunk, n)
+    nch = math.ceil(n / c)
+    npad = nch * c
+    fine = {}
+
+    def timed(name, f, *a):
+        out = jax.block_until_ready(f(*a))  # compile
+        best = float("inf")
+        for _ in range(max(1, reps)):
+            t0 = time.time()
+            out = jax.block_until_ready(f(*a))
+            best = min(best, time.time() - t0)
+        fine[name] = round(best, 3)
+        emit(name, {"seconds": fine[name]})
+        return out
+
+    key = jax.random.key(7)
+    key, gkey, vkey, fkey, ckey = jax.random.split(key, 5)
+
+    # zorder_sort vs band_rerank: a full 1-round knn_project minus the
+    # Morton argsort on the same projection
+    from tsne_flink_tpu.ops.zorder import zorder_permutation
+
+    def zsort(xx, kk_):
+        pkey, _ = jax.random.split(kk_)
+        r = jax.random.normal(pkey, (d, 3), xx.dtype) / jnp.sqrt(
+            jnp.asarray(d, xx.dtype))
+        return zorder_permutation(xx @ r)
+    timed("zorder_sort", jax.jit(zsort), x, gkey)
+    t_round = timed("zorder_round", jax.jit(
+        lambda xx, kk_: K.knn_project(xx, k, rounds=1, key=kk_,
+                                      tiles=tiles, start_round=1)), x, gkey)
+    fine["band_rerank"] = round(
+        max(fine["zorder_round"] - fine["zorder_sort"], 0.0), 3)
+    emit("band_rerank", {"seconds": fine["band_rerank"],
+                         "note": "zorder_round - zorder_sort"})
+
+    # gateway build (top_k gate + reverse sample + expansion + dedup sort)
+    def gateway(gidx, gk, vk):
+        rows_g = jnp.arange(n, dtype=jnp.int32)
+        score = jax.random.uniform(gk, gidx.shape)
+        score = score.at[:, : max(1, s // 2)].set(-jnp.inf)
+        _, gsel = lax.top_k(-score, s)
+        gate = jnp.take_along_axis(gidx, gsel, axis=1)
+        rev = K._reverse_sample(gidx, s, key=vk)
+        rev = jnp.where(rev < 0, rows_g[:, None], rev)
+        u = jnp.sort(jnp.concatenate([gate, rev], axis=1), axis=1)
+        dupu = jnp.concatenate([jnp.zeros((n, 1), bool),
+                                u[:, 1:] == u[:, :-1]], axis=1)
+        u = jnp.where(dupu, rows_g[:, None], u)
+        cand = jnp.concatenate([u, gidx[u][..., :ke].reshape(n, -1)], axis=1)
+        cand = jnp.sort(cand, axis=1)
+        bad = (cand == rows_g[:, None]) | jnp.concatenate(
+            [jnp.zeros((n, 1), bool), cand[:, 1:] == cand[:, :-1]], axis=1)
+        return cand, bad
+    cand, bad = timed("gateway", jax.jit(gateway), idx, gkey, vkey)
+    # measured duplication factor — what dedup-then-gather exploits
+    uniq = jnp.sum(~bad, axis=1)
+    emit("duplication", {
+        "cand_width": int(cand.shape[1]),
+        "mean_unique_per_row": round(float(jnp.mean(uniq)), 1)})
+
+    cpad = jnp.pad(cand, ((0, npad - n), (0, 0)))
+    bpad = jnp.pad(bad, ((0, npad - n), (0, 0)), constant_values=True)
+    rpad = jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, npad - n))
+    cc = cpad.reshape(nch, c, -1)
+    bb = bpad.reshape(nch, c, -1)
+    rr = rpad.reshape(nch, c)
+    sq = jnp.sum(x * x, axis=1)
+
+    def rank_stage(base, bsq, kp, compact):
+        def stage(candc, badc, rcc):
+            def one(aa):
+                cd_, bd_, rc_ = aa
+                ad = jnp.where(bd_, jnp.inf,
+                               K._cand_sqdist(base, bsq, rc_, cd_, compact))
+                _, sel = lax.top_k(-ad, kp)
+                return (jnp.take_along_axis(cd_, sel, axis=1),
+                        jnp.take_along_axis(bd_, sel, axis=1))
+            return lax.map(one, (candc, badc, rcc))
+        return stage
+
+    key2 = jax.random.key(11)
+    cur_c, cur_b = cc, bb
+    if fd:
+        r1 = jax.random.normal(fkey, (d, fd), x.dtype) / jnp.sqrt(
+            jnp.asarray(d, x.dtype))
+        proj = x @ r1
+        psq = jnp.sum(proj * proj, axis=1)
+        cur_c, cur_b = timed("jl_filter",
+                             jax.jit(rank_stage(proj, psq, keep, False)),
+                             cur_c, cur_b, rr)
+    if cd:
+        r2 = jax.random.normal(ckey, (d, cd), x.dtype) / jnp.sqrt(
+            jnp.asarray(d, x.dtype))
+        proj2 = x @ r2
+        p2sq = jnp.sum(proj2 * proj2, axis=1)
+        cur_c, cur_b = timed("cascade",
+                             jax.jit(rank_stage(proj2, p2sq, keep2, False)),
+                             cur_c, cur_b, rr)
+
+    # full-dim rerank, direct vs dedup-then-gather (the backend-policy A/B)
+    def exact_stage(compact):
+        def stage(candc, badc, rcc):
+            def one(aa):
+                cd_, bd_, rc_ = aa
+                return jnp.where(bd_, jnp.inf, K._cand_exact(
+                    "sqeuclidean", x, sq, rc_, cd_, compact))
+            return lax.map(one, (candc, badc, rcc))
+        return stage
+    dd = timed("full_rerank", jax.jit(exact_stage(False)), cur_c, cur_b, rr)
+    timed("full_rerank_dedup_gather", jax.jit(exact_stage(True)),
+          cur_c, cur_b, rr)
+
+    # merge: pre-top-k + id-dedup smallest-k against the current graph
+    ic = jnp.pad(idx, ((0, npad - n), (0, 0))).reshape(nch, c, k)
+    dc = jnp.pad(dist, ((0, npad - n), (0, 0)),
+                 constant_values=jnp.inf).reshape(nch, c, k)
+
+    def merge(candc, ddc, ic_, dc_):
+        def one(aa):
+            cd_, dd_, i_, d_ = aa
+            dk, selk = K._topk_smallest(dd_, k)
+            ck = jnp.take_along_axis(cd_, selk, axis=1)
+            return K._dedup_smallest(jnp.concatenate([i_, ck], axis=1),
+                                     jnp.concatenate([d_, dk], axis=1), k)
+        return lax.map(one, (candc, ddc, ic_, dc_))
+    timed("merge", jax.jit(merge), cur_c, dd, ic, dc)
+    return fine
+
+
+if __name__ == "__main__":
+    sys.exit(main())

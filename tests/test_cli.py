@@ -130,6 +130,38 @@ def test_pick_repulsion_backend_aware():
     assert pick_repulsion("fft", 0.25, 1000, backend="tpu") == "fft"
 
 
+def test_pick_repulsion_3d_tpu_routes_to_exact_below_hbm_limit():
+    """VERDICT r5 weak #3 / round 6: on-chip BH optimize measured 938 s
+    extrapolated at 60k (results/bench_60k_bh_tpu.json), so defaulted-theta
+    3-D auto runs on TPU route to the fused exact kernel wherever its
+    [row_chunk, N] tile fits the HBM budget; BH stays the parity/3-D
+    oracle (explicit theta, beyond-HBM N, and every non-TPU backend)."""
+    from tsne_flink_tpu.utils.cli import exact_hbm_n_max
+
+    lim = exact_hbm_n_max()
+    assert 200_000 < lim < 2_000_000  # ~524k at 16 GiB / 2048-row chunks
+    assert pick_repulsion("auto", 0.25, 200_000, 3, backend="tpu") == "exact"
+    assert pick_repulsion("auto", 0.25, lim, 3, backend="tpu") == "exact"
+    # beyond the HBM working-set limit the octree takes over
+    assert pick_repulsion("auto", 0.25, lim + 1, 3, backend="tpu") == "bh"
+    # an EXPLICIT theta is a request for theta-gated BH semantics, 3-D too
+    assert pick_repulsion("auto", 0.5, 200_000, 3, backend="tpu",
+                          theta_explicit=True) == "bh"
+    # off-TPU 3-D policy unchanged (fft grids can't afford 3-D spacing)
+    assert pick_repulsion("auto", 0.25, 200_000, 3, backend="cpu") == "bh"
+
+
+def test_knn_autotune_flag_parses():
+    a = build_parser().parse_args(
+        ["--input", "i", "--output", "o", "--dimension", "4",
+         "--knnMethod", "project", "--knnAutotune"])
+    assert a.knnAutotune is True
+    a = build_parser().parse_args(
+        ["--input", "i", "--output", "o", "--dimension", "4",
+         "--knnMethod", "project"])
+    assert a.knnAutotune is False
+
+
 @pytest.mark.parametrize("assembly", ["auto", "sorted", "split", "blocks"])
 def test_cli_rejects_any_assembly_with_spmd(tmp_path, assembly):
     # ADVICE r5 #2: models/api.py refuses ANY explicit assembly override

@@ -11,7 +11,11 @@ import pytest
 
 from tsne_flink_tpu.utils.flops import (
     affinity_flops, attraction_flops_per_iter, distance_tile_flops,
-    knn_flops, optimize_flops, peak_flops, repulsion_flops_per_iter)
+    knn_flops, knn_substage_bytes, knn_substage_flops, optimize_flops,
+    peak_flops, repulsion_flops_per_iter)
+
+SUBSTAGES = {"zorder_proj", "zorder_sort", "band_rerank", "gateway",
+             "jl_filter", "cascade", "full_rerank", "merge"}
 
 
 def test_knn_project_beats_bruteforce_at_scale():
@@ -81,3 +85,58 @@ def test_unknown_backends_raise():
         knn_flops(100, 10, 5, "nope")
     with pytest.raises(ValueError):
         repulsion_flops_per_iter(100, 2, "nope")
+
+
+def test_knn_substage_flops_sum_to_stage_total():
+    # one model, two granularities: the bench's stage total and substage
+    # breakdown must be the same numbers (knn_flops docstring)
+    for shape in ((60_000, 784, 90, 3, 6), (20_000, 784, 90, 3, 3),
+                  (5_000, 64, 30, 6, 0)):
+        n, d, k, rounds, refine = shape
+        sub = knn_substage_flops(n, d, k, rounds=rounds,
+                                 refine_rounds=refine)
+        assert set(sub) == SUBSTAGES
+        assert knn_flops(n, d, k, "project", rounds=rounds,
+                         refine_rounds=refine) == pytest.approx(
+            sum(sub.values()))
+
+
+def test_knn_substage_flops_mirror_funnel_policy():
+    # bench shape (d=784, k=90): the cascade engages and the round-6 rule
+    # skips the near-pass-through JL stage (keep 720 of 736 candidates)
+    sub = knn_substage_flops(60_000, 784, 90, rounds=3, refine_rounds=6)
+    assert sub["jl_filter"] == 0.0
+    assert sub["cascade"] > 0.0
+    assert sub["full_rerank"] > 0.0
+    # d=320, k=30: keep (240) < 95% of cand (272) -> JL stage runs
+    sub = knn_substage_flops(1024, 320, 30, rounds=2, refine_rounds=1)
+    assert sub["jl_filter"] > 0.0 and sub["cascade"] > 0.0
+    # small d: no funnel at all, single-stage exact rerank
+    sub = knn_substage_flops(20_000, 64, 90, rounds=3, refine_rounds=3)
+    assert sub["jl_filter"] == 0.0 and sub["cascade"] == 0.0
+    assert sub["full_rerank"] > 0.0
+
+
+def test_knn_substage_bytes_accounting():
+    n, d, k = 60_000, 784, 90
+    b = knn_substage_bytes(n, d, k, rounds=3, refine_rounds=6)
+    assert set(b) == SUBSTAGES
+    assert all(v >= 0 for v in b.values())
+    # the full-dim rerank gather is the dominant refine traffic term at
+    # bench shape (the dedup-then-gather target)
+    assert b["full_rerank"] > b["gateway"]
+    assert b["full_rerank"] > b["band_rerank"]
+    # dedup-then-gather scales the candidate-vector gathers down, and
+    # touches ONLY the funnel gather lines
+    bd = knn_substage_bytes(n, d, k, rounds=3, refine_rounds=6,
+                            dedup_gather=True)
+    assert bd["full_rerank"] < b["full_rerank"]
+    assert bd["cascade"] < b["cascade"]
+    assert bd["band_rerank"] == b["band_rerank"]
+    assert bd["gateway"] == b["gateway"]
+    # no refine -> no funnel traffic
+    b0 = knn_substage_bytes(n, d, k, rounds=3, refine_rounds=0)
+    assert b0["full_rerank"] == 0.0 and b0["merge"] == 0.0
+    # linear-ish in n at fixed plan
+    b2 = knn_substage_bytes(2 * n, d, k, rounds=3, refine_rounds=6)
+    assert b2["full_rerank"] == pytest.approx(2 * b["full_rerank"])

@@ -220,3 +220,67 @@ def test_refine_row_chunk_invariant():
     i2, d2 = knn_refine(x, idx0, dist0, rounds=2, row_chunk=128)
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=0)
+
+
+def test_refine_dedup_gather_identical():
+    """The dedup-then-gather compact form (ops/knn._compact_gather) must be
+    a pure traffic optimization: same vectors land in the same slots, so
+    the refined graph is BIT-identical to the direct-gather path."""
+    from tsne_flink_tpu.ops.knn import _compact_gather, knn_refine
+
+    x = jnp.asarray(blobs(300, 24, seed=9))
+    # raw helper: arbitrary duplicated candidate ids
+    rng = np.random.default_rng(3)
+    cand = jnp.asarray(rng.integers(0, 300, (16, 40)), jnp.int32)
+    np.testing.assert_array_equal(np.asarray(_compact_gather(x, cand)),
+                                  np.asarray(x[cand]))
+    # end to end through the funnel stages
+    idx0, dist0 = knn_project(x, 10, rounds=1, key=jax.random.key(2),
+                              block=64)
+    i1, d1 = knn_refine(x, idx0, dist0, rounds=2, dedup_gather=False)
+    i2, d2 = knn_refine(x, idx0, dist0, rounds=2, dedup_gather=True)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=0)
+
+
+def test_timed_decomposed_path_matches_fused():
+    """knn(on_substage=...) runs the hybrid decomposed into reused jitted
+    stages with identical key splitting — the graph must match the fused
+    path exactly, and the substage dict must cover the plan."""
+    from tsne_flink_tpu.ops.knn import knn as knn_dispatch
+
+    x = jnp.asarray(blobs(600, 32, seed=1))
+    k = 10
+    fused_i, fused_d = jax.jit(lambda a: knn_dispatch(
+        a, k, "project", rounds=2, refine=2, key=jax.random.key(5)))(x)
+    subs = {}
+    ti, td = knn_dispatch(x, k, "project", rounds=2, refine=2,
+                          key=jax.random.key(5), on_substage=subs.update)
+    np.testing.assert_array_equal(np.asarray(fused_i), np.asarray(ti))
+    np.testing.assert_allclose(np.asarray(fused_d), np.asarray(td),
+                               atol=1e-6)
+    assert {"zorder_seed", "zorder_cycles", "merge", "refine"} <= set(subs)
+    assert all(v >= 0 for v in subs.values())
+
+
+def test_project_knn_recall_floor_under_tile_planner():
+    """ISSUE 2 regression pin: knn_project + knn_refine under the new tile
+    planner holds recall@k >= 0.93 at a small-but-meaningful shape (10k x
+    784, the bench's data model, where the auto plan runs 2 hybrid refine
+    cycles through the staged funnel)."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from bench import make_data
+    from measure_recall import recall_at_k
+    from tsne_flink_tpu.ops.knn import (knn as knn_dispatch,
+                                        pick_knn_refine)
+
+    n, k = 10_000, 90
+    assert pick_knn_refine(n, 784) >= 2  # the funnel path must be live
+    x = jnp.asarray(make_data(n, 784))
+    _, dist_exact = knn_bruteforce(x, k)
+    _, dist_approx = knn_dispatch(x, k, "project", key=jax.random.key(0))
+    recall = recall_at_k(np.asarray(dist_approx), np.asarray(dist_exact))
+    assert recall >= 0.93, recall

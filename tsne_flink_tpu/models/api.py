@@ -31,7 +31,8 @@ class TSNE:
                  theta: float | None = None, repulsion: str = "auto",
                  knn_method: str = "bruteforce", neighbors: int | None = None,
                  knn_blocks: int = 8, knn_iterations: int | None = None,
-                 knn_refine: int | None = None, random_state: int = 0,
+                 knn_refine: int | None = None, knn_autotune: bool = False,
+                 random_state: int = 0,
                  spmd: bool = False, devices: int | None = None,
                  sym_mode: str = "replicated", attraction: str = "auto",
                  dtype: str | None = None,
@@ -55,6 +56,10 @@ class TSNE:
         self.knn_blocks = knn_blocks
         self.knn_iterations = knn_iterations
         self.knn_refine = knn_refine
+        # empirical kNN tile autotune (the CLI's --knnAutotune): probe 2-3
+        # candidate tilings on a row slice before the kNN stage and keep
+        # the measured winner; steers only recall-invariant tile shapes
+        self.knn_autotune = knn_autotune
         self.random_state = random_state
         # spmd=True runs the whole job as ONE sharded program over a
         # `devices`-wide point mesh (the CLI's --spmd / SpmdPipeline) —
@@ -195,7 +200,8 @@ class TSNE:
                 x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
                 knn_blocks=self.knn_blocks,
                 knn_iterations=self.knn_iterations,
-                knn_refine=self.knn_refine, seed=self.random_state,
+                knn_refine=self.knn_refine,
+                knn_autotune=self.knn_autotune, seed=self.random_state,
                 affinity_assembly=self.affinity_assembly,
                 artifact_cache=self._artifact_cache())
         self.embedding_ = np.asarray(y)

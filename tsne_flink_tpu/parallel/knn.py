@@ -67,7 +67,8 @@ def _fold_tile(best, x_rows, x_cols, row_ids, col_ids, n_global, k, metric,
 
 def ring_knn(x_local: jnp.ndarray, k: int, n_shards: int, n_global: int,
              metric: str = "sqeuclidean", *, axis_name: str = "points",
-             row_chunk: int = 1024, col_block: int = 8192):
+             row_chunk: int | None = None, col_block: int | None = None,
+             tiles=None):
     """Exact kNN of the local row shard against the GLOBAL point set.
 
     Must run inside ``shard_map`` over a 1-D ``axis_name`` mesh of
@@ -80,6 +81,13 @@ def ring_knn(x_local: jnp.ndarray, k: int, n_shards: int, n_global: int,
     """
     n_local, dim = x_local.shape
     k = _clamp_k(k, n_global)
+    if row_chunk is None or col_block is None:
+        # per-shard tiles from the same analytic plan the single-device
+        # kernels consume (ops/knn_tiles); resolved at trace time
+        from tsne_flink_tpu.ops.knn import _resolve_tiles
+        plan = _resolve_tiles(tiles, n_global, dim, k)
+        row_chunk = plan.row_chunk if row_chunk is None else row_chunk
+        col_block = plan.col_block if col_block is None else col_block
     me = lax.axis_index(axis_name)
     row_ids = me * n_local + jnp.arange(n_local, dtype=jnp.int32)
 
@@ -125,8 +133,8 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
                         n_global: int, metric: str = "sqeuclidean",
                         rounds: int = 3, key: jax.Array | None = None, *,
                         axis_name: str = "points", proj_dims: int = 3,
-                        block: int = 1024, refine_rounds: int = 0,
-                        refine_sample: int = 8):
+                        block: int | None = None, refine_rounds: int = 0,
+                        refine_sample: int = 8, tiles=None):
     """Sharded approximate kNN: random-shift Morton rounds + banded re-rank,
     with the band work split across the mesh by sorted block range.
 
@@ -150,6 +158,10 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
     """
     n_local, dim = x_local.shape
     k = _clamp_k(k, n_global)
+    if block is None:
+        from tsne_flink_tpu.ops.knn import _resolve_tiles
+        tiles = _resolve_tiles(tiles, n_global, dim, k)
+        block = tiles.block
     if key is None:
         key = jax.random.key(0)
     me = lax.axis_index(axis_name)
@@ -268,6 +280,6 @@ def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
                                x_full=x_full,
                                idx_full=idx_full, row_offset=row_offset,
                                n_valid=n_global,
-                               filter_dims=fd,
+                               filter_dims=fd, tiles=tiles,
                                expand_k=(k + 1) // 2 if fd else None)
     return idx, dist

@@ -43,8 +43,10 @@ import numpy as np
 
 MAGIC = "tsne_flink_tpu-artifact-v1"
 #: bump to invalidate every existing entry (layout/algorithm changes that
-#: alter the arrays without changing any fingerprint input)
-FORMAT_VERSION = 1
+#: alter the arrays without changing any fingerprint input).
+#: 2: round-6 refine funnel rework (in-row candidate dedup, JL-stage skip,
+#: pre-top-k merge) — same recall contract, different bits.
+FORMAT_VERSION = 2
 
 KIND_KNN = "knn"
 KIND_AFFINITY = "affinity"
@@ -103,7 +105,18 @@ def knn_fingerprint(data_fp: str, *, n: int, d: int, k: int, method: str,
     """Fingerprint of the kNN graph.  ``rounds``/``refine`` must be the
     RESOLVED plan (ints), so an explicit value equal to the auto policy hits
     the same entry; parameters a method ignores are normalized out so e.g.
-    bruteforce runs with different seeds still share one entry."""
+    bruteforce runs with different seeds still share one entry.
+
+    TILE SIZES ARE DELIBERATELY EXCLUDED (round 6): the tile plan
+    (``ops/knn_tiles``) sizes ``row_chunk``/``block``/chunk widths per
+    backend and may be autotuned per host.  ``row_chunk`` is bit-invariant
+    (pinned by test_refine_row_chunk_invariant); ``block`` changes which
+    candidates the banded sweep sees, so different plans can yield
+    different-bit graphs of EQUAL recall.  The artifact contract pins the
+    recall floor, not bit-identity across plans — keying on tiles would
+    turn every autotune outcome or planner improvement into a full cache
+    miss, re-paying minutes of kNN for a graph that is not measurably
+    better (rationale: ops/knn_tiles module docstring)."""
     if method != "project":
         rounds = refine = None
         key_data = None  # only the Z-order shifts consume the key
@@ -223,6 +236,8 @@ class PrepareResult:
     affinity_cache: str  # off | cold | warm
     knn_fp: str | None
     affinity_fp: str | None
+    knn_substages: dict | None = None  # {substage: seconds} on cold runs
+    knn_tiles: dict | None = None      # resolved tile plan (as_record())
 
     @property
     def cache_label(self) -> str:
@@ -293,7 +308,8 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
             knn_blocks: int = 8, key=None, perplexity: float,
             assembly: str = "auto", sym_width: int | None = None,
             cache: ArtifactCache | None = None,
-            on_stage=None) -> PrepareResult:
+            on_stage=None, knn_tiles=None,
+            knn_autotune: bool = False) -> PrepareResult:
     """THE shared prepare stage: kNN graph -> beta search -> assembled
     joint-P edges, with the artifact cache layered transparently on top.
 
@@ -305,6 +321,14 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
     runs exactly as before this module existed).  ``on_stage(name,
     seconds, cache_state)`` is called after each stage — bench.py uses it
     to emit its window-proof partial records between stages.
+
+    ``knn_tiles`` (an ``ops/knn_tiles.KnnTilePlan``) pins the kNN tile
+    shapes; None resolves the analytic model's plan, and
+    ``knn_autotune=True`` refines it empirically on a row slice of ``x``
+    first (CLI ``--knnAutotune``).  The resolved plan and the cold run's
+    per-substage seconds land in ``PrepareResult.knn_tiles`` /
+    ``.knn_substages``.  Tile sizes are deliberately NOT part of the
+    artifact fingerprint — see :func:`knn_fingerprint`.
     """
     import jax
     import jax.numpy as jnp
@@ -325,6 +349,7 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
 
     # ---- kNN graph ----
     t0 = time.time()
+    knn_subs = tiles_rec = None
     if knn is not None:
         idx, dist = knn
         knn_cache = "input"
@@ -339,10 +364,24 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
             dist = jnp.asarray(got["dist"])
             knn_cache = "warm"
         else:
-            idx, dist = jax.jit(lambda xx: knn_dispatch(
-                xx, k, knn_method, metric, blocks=knn_blocks, rounds=rounds,
-                refine=refine, key=key))(x)
+            # resolve (and optionally autotune) the tile plan only when the
+            # graph is actually computed — a warm hit must not pay a probe
+            from tsne_flink_tpu.ops.knn_tiles import (autotune_knn_tiles,
+                                                      pick_knn_tiles)
+            tiles = knn_tiles or pick_knn_tiles(n, d, k)
+            if knn_autotune and knn_tiles is None:
+                tiles = autotune_knn_tiles(x, k, metric, plan=tiles,
+                                           key=key)
+            tiles_rec = tiles.as_record()
+            # decomposed per-substage dispatch (ops/knn.knn on_substage):
+            # each stage is its own reused jitted executable — compiles
+            # shrink and the substage breakdown is a free byproduct
+            subs: dict = {}
+            idx, dist = knn_dispatch(
+                x, k, knn_method, metric, blocks=knn_blocks, rounds=rounds,
+                refine=refine, key=key, tiles=tiles, on_substage=subs.update)
             idx.block_until_ready()
+            knn_subs = {kk: round(v, 3) for kk, v in subs.items()}
             knn_cache = "off"
             if cache is not None:
                 cache.save(KIND_KNN, knn_fp, {"idx": idx, "dist": dist})
@@ -395,4 +434,5 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
                          extra_edges=extra, label=label,
                          knn_seconds=t_knn, affinity_seconds=t_aff,
                          knn_cache=knn_cache, affinity_cache=affinity_cache,
-                         knn_fp=knn_fp, affinity_fp=affinity_fp)
+                         knn_fp=knn_fp, affinity_fp=affinity_fp,
+                         knn_substages=knn_subs, knn_tiles=tiles_rec)

@@ -67,70 +67,173 @@ def distance_tile_flops(rows: float, cols: float, d: float) -> float:
     return rows * cols * (2.0 * d + 3.0)
 
 
+def _funnel_widths(d: int, k: int, sample: int):
+    """The auto staged-funnel widths, mirrored EXACTLY from ops/knn so the
+    FLOP/byte model cannot drift from what actually runs (ADVICE r3):
+    returns (cand, fd, cd, keep, keep2, ke) where ``fd``/``cd`` are None
+    for a stage that does not run.  Includes the round-6 rule that skips
+    the near-pass-through JL stage when the cascade engages and the
+    stage-1 keep would retain >= 95% of the candidates."""
+    from tsne_flink_tpu.ops.knn import (CASCADE_KEEP, FILTER_KEEP,
+                                        FILTER_KEEP_WIDE, pick_knn_cascade,
+                                        pick_knn_filter)
+    s = min(sample, k)
+    fd = pick_knn_filter(d)
+    cd = pick_knn_cascade(d)
+    ke = (k + 1) // 2 if fd else k  # auto expand_k (ops/knn)
+    cand = 2 * s * (1 + ke)
+    if not fd:
+        return cand, None, None, cand, cand, ke
+    cascade_ok = cd is not None and fd < cd < d
+    keep = min((FILTER_KEEP_WIDE if cascade_ok else FILTER_KEEP) * k, cand)
+    keep2 = min(CASCADE_KEEP * k, keep) if cascade_ok else keep
+    if cascade_ok and keep >= int(0.95 * cand):
+        fd = None                    # JL skipped; cascade ranks everything
+        keep = cand
+        keep2 = min(CASCADE_KEEP * k, cand)
+    if not cascade_ok:
+        cd = None
+    return cand, fd, cd, keep, keep2, ke
+
+
+def knn_substage_flops(n: int, d: int, k: int, *, rounds: int = 3,
+                       proj_dims: int = 3, block: int | None = None,
+                       refine_rounds: int = 0,
+                       refine_sample: int = 8) -> dict:
+    """Per-substage FLOPs of the hybrid project-kNN plan (ops/knn.py),
+    the analytic half of the round-6 observability work: the same
+    substage names ``scripts/profile_knn.py`` measures empirically and
+    ``bench.py`` records, so an on-chip wall-clock can be attributed
+    line-by-line.  Substages:
+
+    * ``zorder_proj`` — per-Z-round Gaussian projection matmuls.
+    * ``zorder_sort`` — Morton-key argsorts: 0 FLOPs by convention (the
+      model counts dense arithmetic; at 60k the sorts are < 0.002% of the
+      stage) but a real BYTE line in :func:`knn_substage_bytes`, so a
+      sort-bound backend still shows up in the traffic attribution.
+    * ``band_rerank`` — the banded exact [b, b+2k] x d tiles.
+    * ``gateway`` — reverse-sample edge sort per refine round.
+    * ``jl_filter`` / ``cascade`` / ``full_rerank`` — the staged funnel
+      (widths from :func:`_funnel_widths`, zero when a stage is skipped).
+    * ``merge`` — per-round candidate merges + per-cycle Z-merge sorts
+      (~2 sorts of width 2k per row each).
+    """
+    if block is None:
+        from tsne_flink_tpu.ops.knn_tiles import MIN_BLOCK
+        block = MIN_BLOCK
+    from tsne_flink_tpu.ops.knn import ZORDER_PER_CYCLE
+    m = min(d, proj_dims)
+    band = min(block, n) + 2 * k
+    zrounds = rounds + refine_rounds * ZORDER_PER_CYCLE
+    sub = {name: 0.0 for name in
+           ("zorder_proj", "zorder_sort", "band_rerank", "gateway",
+            "jl_filter", "cascade", "full_rerank", "merge")}
+    if d > m:
+        sub["zorder_proj"] = zrounds * 2.0 * n * d * m
+    sub["band_rerank"] = zrounds * distance_tile_flops(n, band, d)
+    if refine_rounds > 0:
+        cand, fd, cd, keep, keep2, _ke = _funnel_widths(d, k, refine_sample)
+        r = refine_rounds
+        sub["gateway"] = r * 2.0 * n * k * math.log2(max(2 * n * k, 2))
+        if fd:
+            sub["jl_filter"] = r * (2.0 * n * d * fd + n * cand * 3.0 * fd)
+        if cd:
+            width = keep if fd else cand
+            sub["cascade"] = r * (2.0 * n * d * cd + n * width * 3.0 * cd)
+        sub["full_rerank"] = r * n * keep2 * 3.0 * d
+        # per-round: in-row dedup sort (width cand) + pre-top-k + the 2k
+        # merge sorts; per-cycle: the Z-merge's two width-2k sorts
+        sub["merge"] = r * n * (
+            cand * math.log2(max(cand, 2))
+            + 8.0 * k * math.log2(max(2 * k, 2)))
+    return sub
+
+
 def knn_flops(n: int, d: int, k: int, method: str, *, rounds: int = 3,
-              proj_dims: int = 3, block: int = 1024,
+              proj_dims: int = 3, block: int | None = None,
               refine_rounds: int = 0, refine_sample: int = 8) -> float:
     """kNN stage FLOPs (ops/knn.py).
 
     * bruteforce / partition: the full N x N distance computation (the block
       schedule changes memory, not FLOPs — knn_partition docstring).
-    * project: per round, a Gaussian projection matmul (2*n*d*proj_dims) plus
-      the banded exact re-rank — each of the n/b row blocks computes one
-      [b, b+2k] x d tile, i.e. n * band * d work per round
-      (ops/knn.py:218-244).  Sorts/merges are O(N log N) — negligible next to
-      the d=784 matmuls — and excluded.
-    * hybrid refinement (knn_project_refined): each of the ``refine_rounds``
-      cycles adds ZORDER_PER_CYCLE more Z-order rounds plus one NN-descent
-      round — per refine round each row ranks 2s·(1 + k) local-join
-      candidates (the full k out-lists of its fwd∪rev sample neighborhood)
-      at ~3w ops per pair at ranking width w, plus the edge-list sort for
-      the reverse sample (~2*n*k*log2(2nk) ops).  The staged-rerank widths
-      mirror the auto funnel policy exactly — the constants are IMPORTED
-      from ops/knn (FILTER_KEEP / FILTER_KEEP_WIDE / CASCADE_KEEP,
-      pick_knn_filter / pick_knn_cascade), so a policy change cannot drift
-      the FLOP/MFU model from what actually runs (ADVICE r3): projection
-      matmuls 2*n*d*w per stage width, ~3w per surviving candidate per
-      stage, ~3d for only the final exact survivors.
+    * project: the SUM of :func:`knn_substage_flops` — one model, two
+      granularities, so the bench's stage total and substage breakdown can
+      never disagree (pinned in tests/test_flops.py).  The staged-rerank
+      widths mirror the auto funnel policy exactly — the constants are
+      IMPORTED from ops/knn via :func:`_funnel_widths`, so a policy change
+      cannot drift the FLOP/MFU model from what actually runs (ADVICE r3).
+
+    ``block=None`` uses the planner's floor (ops/knn_tiles.MIN_BLOCK);
+    pass the resolved tile plan's block for an exact mirror of a run.
     """
     if method in ("bruteforce", "partition"):
         return distance_tile_flops(n, n, d)
     if method == "project":
-        m = min(d, proj_dims)
-        band = min(block, n) + 2 * k
-        per_round = 0.0
-        if d > m:
-            per_round += 2.0 * n * d * m
-        per_round += distance_tile_flops(n, band, d)
-        zrounds = rounds
-        total = 0.0
-        if refine_rounds > 0:
-            from tsne_flink_tpu.ops.knn import (CASCADE_KEEP, FILTER_KEEP,
-                                                FILTER_KEEP_WIDE,
-                                                ZORDER_PER_CYCLE,
-                                                pick_knn_cascade,
-                                                pick_knn_filter)
-            zrounds += refine_rounds * ZORDER_PER_CYCLE
-            s = min(refine_sample, k)
-            fd = pick_knn_filter(d)   # mirror the auto staged-funnel policy
-            cd = pick_knn_cascade(d)
-            ke = (k + 1) // 2 if fd else k  # auto expand_k (ops/knn)
-            cand = 2 * s * (1 + ke)
-            if fd:
-                keep = min((FILTER_KEEP_WIDE if cd else FILTER_KEEP) * k,
-                           cand)
-                rank = 2.0 * n * d * fd + n * cand * 3.0 * fd
-                if cd and fd < cd < d:
-                    keep2 = min(CASCADE_KEEP * k, keep)
-                    rank += 2.0 * n * d * cd + n * keep * 3.0 * cd
-                    keep = keep2
-                rank += n * keep * 3.0 * d
-            else:
-                rank = n * cand * 3.0 * d
-            per_ref = rank + 2.0 * n * k * math.log2(max(2 * n * k, 2))
-            total += refine_rounds * per_ref
-        total += zrounds * per_round
-        return total
+        return float(sum(knn_substage_flops(
+            n, d, k, rounds=rounds, proj_dims=proj_dims, block=block,
+            refine_rounds=refine_rounds,
+            refine_sample=refine_sample).values()))
     raise ValueError(f"Knn method '{method}' not defined")
+
+
+def knn_substage_bytes(n: int, d: int, k: int, *, rounds: int = 3,
+                       proj_dims: int = 3, block: int | None = None,
+                       refine_rounds: int = 0, refine_sample: int = 8,
+                       itemsize: int = 4,
+                       dedup_gather: bool = False) -> dict:
+    """Estimated HBM/memory traffic (bytes) per kNN substage — the byte
+    counterpart of :func:`knn_substage_flops`, added in round 6 so
+    arithmetic-intensity (FLOPs/byte) is computable per substage: the
+    round-5 on-chip kNN ran at ~0.04% MFU, a number only explainable by
+    traffic, and this model is what the tile planner's budget reasons
+    about and what ``scripts/profile_knn.py`` compares measurements
+    against.
+
+    Counts the dominant array reads/writes of the shapes actually
+    launched: gathers count their full fetched extent (each [c, Z, d]
+    candidate gather moves Z*d*itemsize per row), sorts count 2 passes
+    over their operands.  ``dedup_gather=True`` scales the funnel's
+    candidate-vector gathers by the measured chunk-unique fraction bound
+    (each unique row fetched once — ops/knn._compact_gather); the 0.5
+    factor is the measured 60k-shape upper bound, so the estimate stays
+    conservative.
+    """
+    if block is None:
+        from tsne_flink_tpu.ops.knn_tiles import MIN_BLOCK
+        block = MIN_BLOCK
+    from tsne_flink_tpu.ops.knn import ZORDER_PER_CYCLE
+    b = min(block, n)
+    band = b + 2 * k
+    zrounds = rounds + refine_rounds * ZORDER_PER_CYCLE
+    it = float(itemsize)
+    sub = {name: 0.0 for name in
+           ("zorder_proj", "zorder_sort", "band_rerank", "gateway",
+            "jl_filter", "cascade", "full_rerank", "merge")}
+    m = min(d, proj_dims)
+    if d > m:
+        sub["zorder_proj"] = zrounds * n * (d + m) * it
+    sub["zorder_sort"] = zrounds * 2.0 * 2.0 * n * it  # keys+perm, 2 passes
+    # per block: gather b+band rows of x, write [b, k] results twice
+    sub["band_rerank"] = zrounds * (n * d * it * (1.0 + band / b)
+                                    + 2.0 * n * k * 2.0 * it)
+    if refine_rounds > 0:
+        cand, fd, cd, keep, keep2, ke = _funnel_widths(d, k, refine_sample)
+        r = refine_rounds
+        s = min(refine_sample, k)
+        gfrac = 0.5 if dedup_gather else 1.0  # measured unique-frac bound
+        # reverse-sample 3-operand edge sort (2 passes) + gateway out-list
+        # expansion gather [n, 2s, ke]
+        sub["gateway"] = r * (3.0 * 2.0 * 2.0 * n * k * it
+                              + n * 2.0 * s * ke * it)
+        if fd:
+            sub["jl_filter"] = r * n * cand * fd * it * gfrac
+        if cd:
+            width = keep if fd else cand
+            sub["cascade"] = r * n * width * cd * it * gfrac
+        sub["full_rerank"] = r * n * keep2 * d * it * gfrac
+        sub["merge"] = r * (n * cand * 2.0 * it          # dedup id sort
+                            + 2.0 * n * 2.0 * k * 2.0 * 2.0 * it)
+    return sub
 
 
 def affinity_flops(n: int, k: int, steps: int = 50) -> float:
