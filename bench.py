@@ -61,8 +61,24 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import numpy as np
 
+# the typed TSNE_* registry (stdlib-only import — the package __init__ is
+# lazy, so this pulls no JAX before the env/wrapper sequencing above)
+from tsne_flink_tpu.utils.env import (env_bool, env_float, env_int, env_str,
+                                      env_setdefault)
+
 DATA_PROVENANCE = "synthetic-blobs"  # no network egress for real MNIST
 DATA_SEED = 0
+
+#: keys EVERY emitted record carries (via the ``base`` dict each emission
+#: site spreads); the bench-record-contract lint rule pins the base literal
+#: and every ``_emit`` site against this schema, and :func:`_emit` enforces
+#: it at runtime — the ADVICE r5 #1 "which assembly ran?" drift class,
+#: closed at both ends.
+RECORD_BASE_KEYS = (
+    "metric", "unit", "backend", "devices", "n", "iterations", "repulsion",
+    "theta", "knn_rounds", "knn_refine", "data", "data_seed", "peak_flops",
+    "peak_flops_basis", "assembly", "cache", "matmul_dtype", "knn_tiles",
+)
 
 
 def make_data(n=60_000, d=784, classes=10, seed=DATA_SEED):
@@ -76,11 +92,11 @@ def make_data(n=60_000, d=784, classes=10, seed=DATA_SEED):
 def _t0() -> float:
     """First-entry wall-clock, shared across the retry wrapper's children via
     the environment so the deadline covers the WHOLE bench invocation."""
-    return float(os.environ.setdefault("TSNE_BENCH_T0", repr(time.time())))
+    return float(env_setdefault("TSNE_BENCH_T0", repr(time.time())))
 
 
 def _deadline_s() -> float:
-    return float(os.environ.get("TSNE_BENCH_DEADLINE_S", "570"))
+    return env_float("TSNE_BENCH_DEADLINE_S")
 
 
 def _remaining() -> float:
@@ -90,6 +106,10 @@ def _remaining() -> float:
 def _emit(rec: dict) -> None:
     """One superseding JSON record: flushed to stdout (the driver parses the
     last line that survives its window) and mirrored to a side file."""
+    missing = [k for k in RECORD_BASE_KEYS if k not in rec]
+    if missing:  # runtime face of the bench-record-contract rule
+        raise AssertionError(f"bench record is missing {missing}; every "
+                             "emission must spread the base dict")
     line = json.dumps(rec)
     print(line, flush=True)
     try:
@@ -143,8 +163,8 @@ def _run_with_retries():
     import subprocess
 
     _t0()  # pin the deadline clock before any child starts
-    retries = max(1, int(os.environ.get("TSNE_BENCH_INIT_RETRIES", "1")))
-    backoff = float(os.environ.get("TSNE_BENCH_INIT_BACKOFF", "30"))
+    retries = max(1, env_int("TSNE_BENCH_INIT_RETRIES"))
+    backoff = env_float("TSNE_BENCH_INIT_BACKOFF")
     env = dict(os.environ, TSNE_BENCH_WRAPPED="1")
     for attempt in range(retries):
         r = subprocess.run([sys.executable, os.path.abspath(__file__)]
@@ -156,8 +176,7 @@ def _run_with_retries():
             print(f"# attempt {attempt + 1}/{retries} hit backend-init "
                   f"timeout; retrying in {wait:.0f}s", file=sys.stderr)
             time.sleep(wait)
-    if os.environ.get("TSNE_BENCH_CPU_FALLBACK",
-                      "1").lower() not in ("", "0", "false"):
+    if env_bool("TSNE_BENCH_CPU_FALLBACK"):
         # DEFAULT ON since round 3 (VERDICT r2: two rounds recorded nothing
         # because this was opt-in).  The JSON carries backend=cpu + an MFU
         # against a nominal CPU peak, so it can never be mistaken for a TPU
@@ -207,12 +226,11 @@ def main():
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
 
-    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+    if env_bool("TSNE_FORCE_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")
     else:
-        _backend_watchdog(
-            float(os.environ.get("TSNE_BENCH_INIT_TIMEOUT", "60")))
+        _backend_watchdog(env_float("TSNE_BENCH_INIT_TIMEOUT"))
 
     import jax
     import jax.numpy as jnp
@@ -240,7 +258,7 @@ def main():
     # old 'sorted' default; the 'assembly' key every record now carries is
     # what makes those eras comparable (pre-r6 records without the key are
     # sorted-era unless their env said otherwise)
-    assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY", "auto")
+    assembly = env_str("TSNE_AFFINITY_ASSEMBLY")
     if assembly not in ("auto", "sorted", "split", "blocks"):
         # same fail-fast contract as the args above
         raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
@@ -275,7 +293,7 @@ def main():
     from tsne_flink_tpu.ops.metrics import default_matmul_dtype, \
         set_matmul_dtype
     matmul_label = "float32"
-    if os.environ.get("TSNE_MATMUL_F32", "").lower() not in ("1", "true"):
+    if not env_bool("TSNE_MATMUL_F32"):
         md = default_matmul_dtype()
         if md is not None:
             set_matmul_dtype(md)
@@ -287,7 +305,7 @@ def main():
     # cache: cold|warm|mixed|off so a warm number can never masquerade as a
     # cold one.  TSNE_ARTIFACTS=0 disables, TSNE_ARTIFACT_DIR moves the root.
     art_cache = None
-    if os.environ.get("TSNE_ARTIFACTS", "1").lower() not in ("0", "false"):
+    if env_bool("TSNE_ARTIFACTS"):
         from tsne_flink_tpu.utils.artifacts import ArtifactCache
         art_cache = ArtifactCache()
 
@@ -337,7 +355,7 @@ def main():
         # fingerprint (recall is pinned, not bit-identity across plans)
         "knn_tiles": tile_plan.as_record(),
     }
-    if os.environ.get("TSNE_TUNNEL_DOWN", "") not in ("", "0"):
+    if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
         # answer — label every record of this fallback run and point at
         # the latest mirrored on-chip evidence
@@ -385,8 +403,7 @@ def main():
                          key=jax.random.key(0), perplexity=cfg.perplexity,
                          assembly=assembly, cache=art_cache,
                          on_stage=on_stage,
-                         knn_autotune=os.environ.get(
-                             "TSNE_KNN_AUTOTUNE", "") not in ("", "0"))
+                         knn_autotune=env_bool("TSNE_KNN_AUTOTUNE"))
     t_knn, t_aff = prep.knn_seconds, prep.affinity_seconds
     jidx, jval, extra = prep.jidx, prep.jval, prep.extra_edges
     label = prep.label
@@ -430,9 +447,9 @@ def main():
     # executable — start_iter and the loss trace are traced arguments) with
     # a superseding record after each; stop when the next segment would
     # cross the deadline and extrapolate the rest
-    seg = int(os.environ.get("TSNE_BENCH_SEG", "0")) or max(
+    seg = env_int("TSNE_BENCH_SEG") or max(
         LOSS_EVERY, min(50, iters // 10 or iters))
-    margin = float(os.environ.get("TSNE_BENCH_MARGIN_S", "20"))
+    margin = env_float("TSNE_BENCH_MARGIN_S")
     t2 = time.time()
     prog = {"it": 0, "state": state, "losses": None,
             "last_seg_s": None, "t_prev": t2}
@@ -527,6 +544,6 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("TSNE_BENCH_WRAPPED", "") in ("", "0"):
+    if not env_bool("TSNE_BENCH_WRAPPED"):
         _run_with_retries()
     main()

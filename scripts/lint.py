@@ -1,0 +1,38 @@
+"""Thin wrapper over ``python -m tsne_flink_tpu.analysis`` (graftlint).
+
+Runs the repo's static-analysis pass over the default target set — the
+package, ``bench.py`` and ``scripts/`` — from any working directory, and
+exits nonzero on findings (CI/tier-1 semantics; ``tests/test_lint.py``
+pins the same invocation).
+
+Usage:
+  python scripts/lint.py              # human-readable findings
+  python scripts/lint.py --json      # machine-readable findings
+  python scripts/lint.py ops/knn.py  # explicit targets instead of defaults
+
+Any extra arguments are passed through (``--rules``, ``--list-rules``,
+``--env-table``, paths).  No JAX import happens anywhere below.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_TARGETS = ["tsne_flink_tpu", "bench.py", "scripts"]
+
+
+def main(argv=None) -> int:
+    from tsne_flink_tpu.analysis.__main__ import main as lint_main
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    os.chdir(REPO)  # targets and finding paths are repo-relative
+    if not any(not a.startswith("-") for a in args) \
+            and "--list-rules" not in args and "--env-table" not in args:
+        args += DEFAULT_TARGETS
+    return lint_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

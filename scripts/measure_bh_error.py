@@ -59,7 +59,8 @@ def main():
     m_dim = a.dims
 
     import jax
-    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+    from tsne_flink_tpu.utils.env import env_bool
+    if env_bool("TSNE_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 

@@ -39,7 +39,8 @@ def main():
     k = int(args[2]) if len(args) > 2 else 90
 
     import jax
-    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+    from tsne_flink_tpu.utils.env import env_bool
+    if env_bool("TSNE_FORCE_CPU"):
         # sitecustomize latches JAX_PLATFORMS to the accelerator before any
         # script code runs; config update is the only reliable CPU pin
         jax.config.update("jax_platforms", "cpu")

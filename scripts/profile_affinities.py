@@ -38,7 +38,8 @@ def main():
     reps = int(args[2]) if len(args) > 2 else 3
 
     import jax
-    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+    from tsne_flink_tpu.utils.env import env_bool
+    if env_bool("TSNE_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from functools import partial

@@ -39,8 +39,9 @@ from sklearn.manifold import trustworthiness
 
 import jax
 
-jax.config.update("jax_platforms",
-                  os.environ.get("TSNE_QUALITY_BACKEND", "cpu"))
+from tsne_flink_tpu.utils.env import env_str
+
+jax.config.update("jax_platforms", env_str("TSNE_QUALITY_BACKEND"))
 
 
 def main():

@@ -48,7 +48,8 @@ def make_knn_coo(path, n, d, k, seed=0):
     rng = np.random.default_rng(seed)
     x = rng.standard_normal((n, d)).astype(np.float32)
     import jax
-    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+    from tsne_flink_tpu.utils.env import env_bool
+    if env_bool("TSNE_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     if n >= 100_000:

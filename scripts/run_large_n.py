@@ -44,7 +44,10 @@ os.environ["XLA_FLAGS"] = _flags
 
 import jax
 
-if os.environ.get("TSNE_FORCE_CPU", "1").lower() not in ("", "0", "false"):
+from tsne_flink_tpu.utils.env import env_bool
+
+# call-site default ON: the 8-virtual-device mesh above is CPU-only
+if env_bool("TSNE_FORCE_CPU", default=True):
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np

@@ -34,8 +34,9 @@ import numpy as np
 # Set TSNE_QUALITY_BACKEND=tpu to measure the accelerator path instead.
 import jax
 
-jax.config.update("jax_platforms",
-                  os.environ.get("TSNE_QUALITY_BACKEND", "cpu"))
+from tsne_flink_tpu.utils.env import env_str
+
+jax.config.update("jax_platforms", env_str("TSNE_QUALITY_BACKEND"))
 
 
 def main():

@@ -1,0 +1,21 @@
+"""bench-record-contract fixture: a base dict missing a declared key and an
+emission site that does not spread base, plus conforming twins."""
+
+RECORD_BASE_KEYS = ("metric", "unit", "backend")
+
+
+def _emit(rec):
+    pass
+
+
+base = {"metric": "fixture_seconds", "unit": "s"}  # VIOLATION: no 'backend'
+
+_emit({"metric": "fixture_seconds"})  # VIOLATION: does not spread **base
+
+_emit({**base, "value": 1.0})  # conforming: spreads base
+
+rec = {**base, "value": 2.0}
+_emit(rec)  # conforming: rec spreads base
+
+# graftlint: disable=bench-record-contract -- fixture: suppressed twin
+_emit({"metric": "fixture_seconds"})

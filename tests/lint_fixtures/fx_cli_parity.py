@@ -1,0 +1,21 @@
+"""cli-api-parity fixture: a build_parser/TSNE pair with one default
+mismatch and one missing counterpart each way."""
+
+import argparse
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--perplexity", type=float, default=30.0)
+    p.add_argument("--learningRate", type=float, default=500.0)  # VIOLATION: API says 1000.0
+    p.add_argument("--fixtureOnlyFlag", default=None)  # VIOLATION: no kwarg
+    p.add_argument("--input", required=True)  # CLI_ONLY: never flagged
+    return p
+
+
+class TSNE:
+    def __init__(self, perplexity=30.0, learning_rate=1000.0,
+                 fixture_only_kwarg=None):  # VIOLATION: no CLI flag
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.fixture_only_kwarg = fixture_only_kwarg

@@ -1,0 +1,14 @@
+"""env-registry fixture: one raw read, one undeclared name, two suppressed
+twins.  Never imported — lint test data only."""
+
+import os
+
+from tsne_flink_tpu.utils.env import env_bool
+
+RAW_READ = os.environ.get("TSNE_FORCE_CPU", "")  # VIOLATION: raw read
+
+UNDECLARED = env_bool("TSNE_FIXTURE_ONLY_KNOB")  # VIOLATION: undeclared
+
+SUPPRESSED_RAW = os.environ.get("TSNE_FORCE_CPU", "")  # graftlint: disable=env-registry -- fixture
+
+SUPPRESSED_UNDECL = env_bool("TSNE_FIXTURE_OTHER_KNOB")  # graftlint: disable=env-registry -- fixture

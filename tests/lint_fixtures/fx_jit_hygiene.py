@@ -1,0 +1,28 @@
+"""jit-hygiene fixture: an unstatic str control arg, an undonated segment
+runner, and suppressed/clean twins.  Never imported — lint test data."""
+
+from functools import partial
+
+import jax
+
+
+def kernel(x, mode="fast"):
+    return x
+
+
+def optimize(state, jidx, jval):
+    return state
+
+
+BAD_STATIC = jax.jit(kernel)  # VIOLATION: 'mode' not static
+
+BAD_DONATE = jax.jit(partial(optimize))  # VIOLATION: no donate_argnums
+
+OK_STATIC = jax.jit(kernel, static_argnames=("mode",))
+
+OK_BOUND = jax.jit(partial(kernel, mode="slow"))
+
+OK_DONATE = jax.jit(partial(optimize), donate_argnums=(0,))
+
+# graftlint: disable=jit-hygiene -- fixture: suppressed twin of BAD_DONATE
+SUPPRESSED = jax.jit(partial(optimize))

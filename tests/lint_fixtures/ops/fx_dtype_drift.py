@@ -1,0 +1,17 @@
+"""dtype-drift fixture (under ops/ — the rule scopes by path): dtype-less
+float-literal arrays and a bare np.float64, plus clean/suppressed twins."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def f():
+    a = jnp.asarray(0.5)                      # VIOLATION: dtype-less float
+    b = jnp.array([1.0, 2.0])                 # VIOLATION: dtype-less floats
+    c = np.float64(3.0)                       # VIOLATION: bare np.float64
+    ok1 = jnp.asarray(0.5, jnp.float32)       # dtype given positionally
+    ok2 = jnp.asarray(1e-6, dtype=jnp.float32)
+    ok3 = jnp.asarray(7)                      # int literal: exact either way
+    sup = jnp.asarray(0.25)  # graftlint: disable=dtype-drift -- fixture
+    return a, b, c, ok1, ok2, ok3, sup

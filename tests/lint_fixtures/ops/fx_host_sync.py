@@ -1,0 +1,21 @@
+"""host-sync fixture (lives under ops/ because the rule scopes by path):
+one device->host sync per flavor, plus suppressed twins."""
+
+import numpy as np
+
+import jax
+
+
+def hot_loop(y):
+    z = y.sum().item()              # VIOLATION: .item()
+    f = float(z)                    # VIOLATION: float(name)
+    h = np.asarray(y)               # VIOLATION: np.asarray
+    jax.block_until_ready(y)        # VIOLATION: block_until_ready
+    return z, f, h
+
+
+def timed_loop(y):
+    # graftlint: disable=host-sync -- fixture: deliberate timing sync
+    jax.block_until_ready(y)
+    ok = float(y.shape[0] + 1)  # host arithmetic: never flagged
+    return ok

@@ -1,0 +1,211 @@
+"""graftlint tier-1 contract (ISSUE 3 tentpole).
+
+Three layers:
+
+* the REPO IS CLEAN: the analyzer over the package + bench.py + scripts
+  reports zero findings (the machine-checked floor under every future PR —
+  a new raw env read, an unstatic jit control arg, a bench emission that
+  drops the schema, or CLI/API drift fails tier-1 here);
+* the RULES FIRE: every seeded violation in tests/lint_fixtures/ is
+  detected by its rule at exactly the marked lines, and the suppressed
+  twins stay silent;
+* the ANALYZER IS JAX-FREE: importing and running it pulls no jax module
+  (it must work from a bare source tree, and it keeps this suite fast).
+
+Pure-ast throughout — no JAX import, so the whole module is explicitly
+``fast``-tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+LINT_TARGETS = [os.path.join(REPO, "tsne_flink_tpu"),
+                os.path.join(REPO, "bench.py"),
+                os.path.join(REPO, "scripts")]
+
+from tsne_flink_tpu.analysis import RULES, run  # noqa: E402
+from tsne_flink_tpu.analysis import rules as _rules  # noqa: E402,F401
+
+
+def run_rule(rule, *paths):
+    findings, _ = run([os.path.join(FIXTURES, p) for p in paths],
+                      root=REPO, rules=[rule])
+    return findings
+
+
+def violation_lines(fixture):
+    """Line numbers marked ``# VIOLATION`` in a fixture file."""
+    path = os.path.join(FIXTURES, fixture)
+    with open(path) as f:
+        return {i for i, line in enumerate(f, 1) if "VIOLATION" in line}
+
+
+# ---- the repo is clean -----------------------------------------------------
+
+def test_repo_is_lint_clean():
+    findings, n_files = run(LINT_TARGETS, root=REPO)
+    assert n_files > 40  # the whole package + bench + scripts was scanned
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_all_rules_registered():
+    assert set(RULES) == {"env-registry", "jit-hygiene", "host-sync",
+                          "dtype-drift", "bench-record-contract",
+                          "cli-api-parity"}
+
+
+# ---- every fixture violation is found, suppressions silence ---------------
+
+FIXTURE_FOR_RULE = {
+    "env-registry": "fx_env_registry.py",
+    "jit-hygiene": "fx_jit_hygiene.py",
+    "host-sync": os.path.join("ops", "fx_host_sync.py"),
+    "dtype-drift": os.path.join("ops", "fx_dtype_drift.py"),
+    "bench-record-contract": "fx_bench_contract.py",
+    "cli-api-parity": "fx_cli_parity.py",
+}
+
+
+@pytest.mark.parametrize("rule,fixture", sorted(FIXTURE_FOR_RULE.items()))
+def test_rule_fires_exactly_at_seeded_violations(rule, fixture):
+    findings = run_rule(rule, fixture)
+    assert findings, f"rule {rule} found nothing in {fixture}"
+    assert {f.rule for f in findings} == {rule}
+    expected = violation_lines(fixture)
+    got = {f.line for f in findings}
+    assert got == expected, (f"{rule}: findings at {sorted(got)}, seeded "
+                             f"violations at {sorted(expected)}")
+
+
+def test_suppression_comment_silences(tmp_path):
+    src = ("import os\n"
+           "A = os.environ.get('TSNE_FORCE_CPU', '')\n"
+           "B = os.environ.get('TSNE_FORCE_CPU', '')"
+           "  # graftlint: disable=env-registry -- trailing\n"
+           "# graftlint: disable=env-registry -- standalone, multi-line\n"
+           "# rationale continues on a second comment line\n"
+           "C = os.environ.get('TSNE_FORCE_CPU', '')\n")
+    p = tmp_path / "sup.py"
+    p.write_text(src)
+    findings, _ = run([str(p)], root=str(tmp_path), rules=["env-registry"])
+    assert [f.line for f in findings] == [2]
+
+
+def test_file_level_suppression(tmp_path):
+    p = tmp_path / "supfile.py"
+    p.write_text("# graftlint: disable-file=env-registry -- whole file\n"
+                 "import os\n"
+                 "A = os.environ.get('TSNE_FORCE_CPU', '')\n")
+    findings, _ = run([str(p)], root=str(tmp_path), rules=["env-registry"])
+    assert findings == []
+
+
+# ---- env registry completeness --------------------------------------------
+
+def test_every_tsne_var_in_repo_is_declared():
+    """All TSNE_* names used anywhere in the lint targets resolve through
+    the registry (the acceptance criterion's '19 pre-existing vars')."""
+    import re
+    from tsne_flink_tpu.utils.env import declared_vars
+    declared = {v.name for v in declared_vars()}
+    assert len(declared) >= 19
+    used = set()
+    for target in LINT_TARGETS:
+        files = ([target] if target.endswith(".py") else
+                 [os.path.join(dp, f) for dp, _, fs in os.walk(target)
+                  for f in fs if f.endswith(".py")])
+        for path in files:
+            with open(path, encoding="utf-8") as f:
+                used.update(re.findall(r"[\"'](TSNE_[A-Z0-9_]+)[\"']",
+                                       f.read()))
+    assert used <= declared, f"undeclared: {sorted(used - declared)}"
+
+
+def test_typed_reads(monkeypatch):
+    from tsne_flink_tpu.utils import env
+
+    monkeypatch.delenv("TSNE_FORCE_CPU", raising=False)
+    assert env.env_bool("TSNE_FORCE_CPU") is False
+    monkeypatch.setenv("TSNE_FORCE_CPU", "1")
+    assert env.env_bool("TSNE_FORCE_CPU") is True
+    monkeypatch.setenv("TSNE_FORCE_CPU", "false")
+    assert env.env_bool("TSNE_FORCE_CPU") is False
+    monkeypatch.setenv("TSNE_FORCE_CPU", "")  # empty = unset = default
+    assert env.env_bool("TSNE_FORCE_CPU") is False
+    assert env.env_bool("TSNE_FORCE_CPU", default=True) is True
+
+    monkeypatch.delenv("TSNE_BENCH_DEADLINE_S", raising=False)
+    assert env.env_float("TSNE_BENCH_DEADLINE_S") == 570.0
+    monkeypatch.setenv("TSNE_BENCH_DEADLINE_S", "12.5")
+    assert env.env_float("TSNE_BENCH_DEADLINE_S") == 12.5
+    monkeypatch.setenv("TSNE_BENCH_SEG", "bogus")
+    with pytest.raises(ValueError, match="TSNE_BENCH_SEG"):
+        env.env_int("TSNE_BENCH_SEG")
+
+    with pytest.raises(KeyError, match="not declared"):
+        env.env_raw("TSNE_NOT_A_REAL_KNOB")  # graftlint: disable=env-registry -- negative test
+
+    monkeypatch.delenv("TSNE_BENCH_T0", raising=False)
+    assert env.env_setdefault("TSNE_BENCH_T0", "123.0") == "123.0"
+    assert env.env_setdefault("TSNE_BENCH_T0", "456.0") == "123.0"
+
+
+def test_env_table_covers_registry():
+    from tsne_flink_tpu.utils.env import declared_vars, env_table_markdown
+    table = env_table_markdown()
+    for var in declared_vars():
+        assert f"`{var.name}`" in table
+
+
+def test_readme_env_table_in_sync():
+    """The README section is generated from the registry; a new knob must
+    regenerate it (python -m tsne_flink_tpu.analysis --env-table)."""
+    from tsne_flink_tpu.utils.env import declared_vars
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    for var in declared_vars():
+        assert f"`{var.name}`" in readme, (
+            f"README env-var table is missing {var.name}; regenerate with "
+            "python -m tsne_flink_tpu.analysis --env-table")
+
+
+# ---- the analyzer is JAX-free ---------------------------------------------
+
+def test_analyzer_imports_without_jax():
+    code = ("import sys\n"
+            "import tsne_flink_tpu.analysis\n"
+            "import tsne_flink_tpu.analysis.rules\n"
+            "import tsne_flink_tpu.utils.env\n"
+            "assert not any(m == 'jax' or m.startswith('jax.') "
+            "for m in sys.modules), 'analysis pulled in jax'\n")
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=REPO)
+
+
+def test_module_entry_point_json_and_exit_codes():
+    """The acceptance invocation: clean repo -> exit 0 + ok JSON; a seeded
+    violation -> exit 1 and the finding in the JSON payload."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tsne_flink_tpu.analysis", "--json",
+         "tsne_flink_tpu", "bench.py", "scripts"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is True and payload["findings"] == []
+    assert payload["files_scanned"] > 40
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tsne_flink_tpu.analysis", "--json",
+         os.path.join("tests", "lint_fixtures", "fx_env_registry.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is False
+    assert any(f["rule"] == "env-registry" for f in payload["findings"])

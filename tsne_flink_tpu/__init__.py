@@ -12,25 +12,44 @@ see ``/root/reference``), redesigned for TPU:
 * three chained bulk iterations  -> one ``lax.fori_loop`` with iteration-gated
                                     momentum / early-exaggeration switches
 
-Public API re-exports the high-level entry points.
+Public API re-exports the high-level entry points — LAZILY (PEP 562), so
+that the JAX-free corners of the package stay importable without JAX: the
+static analyzer (``python -m tsne_flink_tpu.analysis``) and the env-var
+registry (``tsne_flink_tpu.utils.env``) must run from a bare source tree,
+and entry points that sequence environment setup before JAX initialization
+(``bench.py``, ``scripts/run_large_n.py``) must be able to import the
+registry without triggering a JAX import.  ``from tsne_flink_tpu import
+TSNE`` still works exactly as before — the first attribute access performs
+the real import.
 """
 
-from tsne_flink_tpu.models.tsne import (  # noqa: F401
-    TsneConfig,
-    TsneState,
-    init_working_set,
-    optimize,
-    tsne_embed,
-)
-from tsne_flink_tpu.ops.knn import (  # noqa: F401
-    knn_bruteforce,
-    knn_partition,
-    knn_project,
-)
-from tsne_flink_tpu.ops.affinities import (  # noqa: F401
-    pairwise_affinities,
-    joint_distribution,
-)
-from tsne_flink_tpu.models.api import TSNE  # noqa: F401
+_PUBLIC = {
+    "TsneConfig": "tsne_flink_tpu.models.tsne",
+    "TsneState": "tsne_flink_tpu.models.tsne",
+    "init_working_set": "tsne_flink_tpu.models.tsne",
+    "optimize": "tsne_flink_tpu.models.tsne",
+    "tsne_embed": "tsne_flink_tpu.models.tsne",
+    "knn_bruteforce": "tsne_flink_tpu.ops.knn",
+    "knn_partition": "tsne_flink_tpu.ops.knn",
+    "knn_project": "tsne_flink_tpu.ops.knn",
+    "pairwise_affinities": "tsne_flink_tpu.ops.affinities",
+    "joint_distribution": "tsne_flink_tpu.ops.affinities",
+    "TSNE": "tsne_flink_tpu.models.api",
+}
+
+__all__ = sorted(_PUBLIC) + ["__version__"]
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name: str):
+    target = _PUBLIC.get(name)
+    if target is None:
+        raise AttributeError(f"module 'tsne_flink_tpu' has no attribute "
+                             f"'{name}'")
+    import importlib
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,27 @@
+"""graftlint — the repo-native static-analysis pass.
+
+Pure-stdlib ``ast`` analysis (importable and runnable without JAX) with a
+rule registry, per-rule suppression comments and JSON/human output:
+
+* ``python -m tsne_flink_tpu.analysis tsne_flink_tpu bench.py scripts``
+  runs every rule and exits nonzero on findings (tier-1 pins this clean
+  via ``tests/test_lint.py``; ``scripts/lint.py`` is the thin wrapper);
+* ``--json`` emits machine-readable findings;
+* ``--env-table`` prints the README's env-var table from
+  :mod:`tsne_flink_tpu.utils.env`;
+* ``# graftlint: disable=<rule> -- <rationale>`` silences one finding.
+
+Rules live in :mod:`tsne_flink_tpu.analysis.rules`; the framework in
+:mod:`tsne_flink_tpu.analysis.core`.  To add a rule, write a
+``@rule("name", "doc")`` function over the parsed :class:`~core.Project`
+and return :class:`~core.Finding` objects — see docs/ARCHITECTURE.md.
+"""
+
+from tsne_flink_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULES,
+    render_human,
+    render_json,
+    rule,
+    run,
+)

@@ -1,0 +1,58 @@
+"""``python -m tsne_flink_tpu.analysis`` — the graftlint CLI.
+
+Exit status: 0 = clean, 1 = findings, 2 = usage error.  Never imports JAX
+(pinned by tests/test_lint.py), so it runs in seconds anywhere the source
+tree exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tsne_flink_tpu.analysis import core
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tsne_flink_tpu.analysis",
+        description="graftlint: repo-native static analysis "
+                    "(JAX hygiene, env registry, contract checks)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (e.g. tsne_flink_tpu "
+                        "bench.py scripts)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset of rules to run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the registered rules and exit")
+    p.add_argument("--env-table", action="store_true",
+                   help="print the env-var registry as a markdown table "
+                        "(the README section is generated from this)")
+    args = p.parse_args(argv)
+
+    if args.env_table:
+        # stdlib-only import: the registry is deliberately JAX-free
+        from tsne_flink_tpu.utils.env import env_table_markdown
+        print(env_table_markdown())
+        return 0
+    if args.list_rules:
+        from tsne_flink_tpu.analysis import rules as _rules  # noqa: F401
+        for name, fn in sorted(core.RULES.items()):
+            print(f"{name}: {fn.rule_doc}")
+        return 0
+    if not args.paths:
+        p.error("no paths given (and neither --env-table nor --list-rules)")
+    selected = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                if args.rules else None)
+    findings, n_files = core.run(args.paths, rules=selected)
+    if args.json:
+        print(core.render_json(findings, n_files))
+    else:
+        print(core.render_human(findings, n_files))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

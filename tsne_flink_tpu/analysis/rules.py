@@ -1,0 +1,678 @@
+"""graftlint rules — the repo-specific checks.
+
+Each rule encodes a contract a reviewer has already had to catch by hand
+once (ADVICE/VERDICT rounds 1-5); the linter catches it forever:
+
+* ``env-registry``     — every ``TSNE_*`` read goes through
+  ``utils/env.py``; undeclared names are findings.
+* ``jit-hygiene``      — jitted functions with str/bool/dict control
+  arguments declare them static (or bind them via ``functools.partial``);
+  the segment-loop jits of ``optimize`` either donate their re-bound state
+  buffers or carry a suppression explaining why they cannot.
+* ``host-sync``        — ``.item()`` / ``float(x)`` / ``np.asarray`` /
+  ``block_until_ready`` inside ``ops/`` and the ``models/tsne.py``
+  step/loop functions (each forces a device roundtrip mid-hot-path).
+* ``dtype-drift``      — dtype-less ``jnp.array``/``jnp.asarray`` of float
+  literals and bare ``np.float64`` in ``ops/`` (silent f64 upcasts under
+  the x64 test config).
+* ``bench-record-contract`` — every bench record emission spreads the
+  ``base`` dict, and ``base`` carries every key ``RECORD_BASE_KEYS``
+  declares (the ADVICE r5 #1 drift class, closed permanently).
+* ``cli-api-parity``   — argparse flags in ``build_parser`` against
+  ``TSNE.__init__`` kwargs: missing counterparts and mismatched defaults.
+
+Rules are pure-AST project passes registered with :func:`core.rule`; they
+never import the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tsne_flink_tpu.analysis.core import Finding, Project, rule
+
+ENV_NAME_RE = re.compile(r"TSNE_[A-Z0-9_]+\Z")
+ENV_PREFIX = "TSNE_"
+
+
+# ---- shared AST helpers ----------------------------------------------------
+
+def _import_aliases(tree: ast.AST, module_name: str) -> set[str]:
+    """Local names bound to ``module_name`` by any import in the file
+    (``import os``, ``import os as _os``, nested function imports too)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == module_name:
+                    names.add(alias.asname or module_name)
+    return names
+
+
+def _from_import_aliases(tree: ast.AST, func_name: str) -> set[str]:
+    """Local names bound to ``func_name`` via ``from X import func_name``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == func_name:
+                    names.add(alias.asname or func_name)
+    return names
+
+
+def _const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_name_in(node, names: set[str]) -> bool:
+    return isinstance(node, ast.Name) and node.id in names
+
+
+def _literal(node):
+    """ast.literal_eval that returns a sentinel instead of raising."""
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return _literal  # unmistakable sentinel
+
+
+def _functions_with_parents(tree: ast.AST):
+    """Yield (funcdef, qualname) for every def/lambda-free function."""
+    stack = [(tree, "")]
+    while stack:
+        node, prefix = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield child, qual
+                stack.append((child, qual + "."))
+            else:
+                stack.append((child, prefix))
+
+
+# ---- rule: env-registry ----------------------------------------------------
+
+def _declared_env_vars(project: Project) -> set[str]:
+    """Names declared in utils/env.py (``_declare("NAME", ...)`` calls),
+    parsed from the scanned copy — or, when the registry module is not in
+    the scan set (fixture runs), from the file shipped next to this
+    package."""
+    mod = project.module_with_suffix("utils/env.py")
+    tree = mod.tree if mod is not None else None
+    if tree is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "utils", "env.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except OSError:
+            return set()
+    declared = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "_declare" and node.args):
+            name = _const_str(node.args[0])
+            if name:
+                declared.add(name)
+    return declared
+
+
+def _environ_read_key(node: ast.Call | ast.Subscript, os_names: set[str]):
+    """The key expression of a raw environment READ, or None.
+
+    Reads: ``os.environ.get(k)``, ``os.environ.setdefault(k, v)``,
+    ``os.getenv(k)``, ``os.environ[k]`` in load context.  Writes
+    (``os.environ[k] = v``) are allowed — mutating the child-process
+    environment is not a configuration read."""
+    if isinstance(node, ast.Call):
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        if (func.attr in ("get", "setdefault", "pop")
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "environ"
+                and _is_name_in(func.value.value, os_names) and node.args):
+            return node.args[0]
+        if (func.attr == "getenv" and _is_name_in(func.value, os_names)
+                and node.args):
+            return node.args[0]
+        return None
+    if isinstance(node, ast.Subscript):
+        if (isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "environ"
+                and _is_name_in(node.value.value, os_names)):
+            return node.slice
+    return None
+
+
+@rule("env-registry",
+      "TSNE_* environment variables are read through utils/env.py and "
+      "declared there")
+def env_registry(project: Project):
+    findings = []
+    declared = _declared_env_vars(project)
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        if norm.endswith("utils/env.py"):
+            continue  # the registry is the one place raw reads live
+        os_names = _import_aliases(mod.tree, "os")
+        read_keys: set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.Call, ast.Subscript)):
+                continue
+            key = _environ_read_key(node, os_names)
+            if key is None:
+                continue
+            lit = _const_str(key)
+            if lit is None:
+                findings.append(mod.finding(
+                    "env-registry", node,
+                    "raw environment read with a non-literal key — the "
+                    "registry cannot verify it; read through "
+                    "tsne_flink_tpu.utils.env or suppress with the "
+                    "rationale"))
+            elif lit.startswith(ENV_PREFIX):
+                read_keys.add(id(key))
+                findings.append(mod.finding(
+                    "env-registry", node,
+                    f"raw environment read of {lit}; use "
+                    "tsne_flink_tpu.utils.env (env_bool/env_int/env_float/"
+                    "env_str/env_raw) so the knob stays typed and "
+                    "documented"))
+        for node in ast.walk(mod.tree):
+            name = _const_str(node)
+            if (name is not None and ENV_NAME_RE.fullmatch(name)
+                    and name not in declared and id(node) not in read_keys):
+                findings.append(mod.finding(
+                    "env-registry", node,
+                    f"undeclared environment variable {name}: add an entry "
+                    "to tsne_flink_tpu/utils/env.py (name, type, default, "
+                    "help)"))
+    return findings
+
+
+# ---- rule: jit-hygiene -----------------------------------------------------
+
+#: functions whose jit wrappers re-bind large state buffers every segment
+#: of the optimize loop — they must donate, or explain why they cannot
+SEGMENT_RUNNERS = ("optimize",)
+
+_CONTROL_TYPE_NAMES = ("str", "bool", "dict")
+
+
+def _is_control_default(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (str, bool)) and node.value is not None
+    return isinstance(node, ast.Dict)
+
+
+def _is_control_annotation(node) -> bool:
+    """True for annotations mentioning bare str/bool/dict (including
+    ``str | None`` unions) — values jit can never trace."""
+    if node is None:
+        return False
+    return any(isinstance(sub, ast.Name) and sub.id in _CONTROL_TYPE_NAMES
+               for sub in ast.walk(node))
+
+
+def _control_params(fn: ast.FunctionDef) -> dict[str, ast.arg]:
+    """Params whose default or annotation marks them as Python-level
+    control values (str/bool/dict)."""
+    out = {}
+    args = fn.args
+    pos = list(args.posonlyargs) + list(args.args)
+    defaults = [None] * (len(pos) - len(args.defaults)) + list(args.defaults)
+    for a, d in zip(pos, defaults):
+        if _is_control_default(d) or _is_control_annotation(a.annotation):
+            out[a.arg] = a
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if _is_control_default(d) or _is_control_annotation(a.annotation):
+            out[a.arg] = a
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    args = fn.args
+    return [a.arg for a in
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)]
+
+
+def _unwrap_partial(node, partial_names: set[str]):
+    """(inner_target, bound_kwargs, n_bound_positional) through one
+    functools.partial layer; identity for a bare target."""
+    if (isinstance(node, ast.Call)
+            and ((isinstance(node.func, ast.Name)
+                  and node.func.id in partial_names)
+                 or (isinstance(node.func, ast.Attribute)
+                     and node.func.attr == "partial")) and node.args):
+        return (node.args[0], {kw.arg for kw in node.keywords if kw.arg},
+                len(node.args) - 1)
+    return node, set(), 0
+
+
+def _module_constant(mod, name: str):
+    """The literal value of a module-level ``NAME = <literal>`` assignment
+    (so ``static_argnames=_SOME_TUPLE`` resolves), or the sentinel."""
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)):
+            return _literal(node.value)
+    return _literal
+
+
+def _jit_static_names(call: ast.Call, mod) -> tuple[set[str], set[int]]:
+    """(static_argnames, static_argnums) from a jit call, resolving
+    module-level constant references."""
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        val = _literal(kw.value)
+        if val is _literal and isinstance(kw.value, ast.Name):
+            val = _module_constant(mod, kw.value.id)
+        if kw.arg == "static_argnames":
+            if isinstance(val, str):
+                names.add(val)
+            elif isinstance(val, (tuple, list)):
+                names.update(v for v in val if isinstance(v, str))
+        else:
+            if isinstance(val, int):
+                nums.add(val)
+            elif isinstance(val, (tuple, list)):
+                nums.update(v for v in val if isinstance(v, int))
+    return names, nums
+
+
+def _has_donation(call: ast.Call) -> bool:
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in call.keywords)
+
+
+@rule("jit-hygiene",
+      "jitted functions declare str/bool/dict control args static; "
+      "segment-loop optimize jits donate their re-bound buffers")
+def jit_hygiene(project: Project):
+    findings = []
+    for mod in project.modules:
+        partial_names = _from_import_aliases(mod.tree, "partial")
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "jit")
+                         or (isinstance(node.func, ast.Name)
+                             and node.func.id == "jit"))
+                    and node.args):
+                continue
+            target, bound_kw, bound_pos = _unwrap_partial(
+                node.args[0], partial_names)
+            if not isinstance(target, ast.Name):
+                continue  # lambdas close over their controls; shard_map etc.
+            fn = project.resolve_function(mod, target.id)
+            if fn is None:
+                continue
+            if (fn.name in SEGMENT_RUNNERS and not _has_donation(node)):
+                findings.append(mod.finding(
+                    "jit-hygiene", node,
+                    f"jit of segment runner '{fn.name}' without "
+                    "donate_argnums: the state buffers are re-bound every "
+                    "segment; donate them, or suppress with the rationale "
+                    "that makes donation unsafe here"))
+            static_names, static_nums = _jit_static_names(node, mod)
+            params = _param_names(fn)
+            covered = set(static_names) | set(bound_kw)
+            covered.update(params[i] for i in range(min(bound_pos,
+                                                        len(params))))
+            covered.update(params[i] for i in static_nums
+                           if i < len(params))
+            for name in _control_params(fn):
+                if name in covered:
+                    continue
+                findings.append(mod.finding(
+                    "jit-hygiene", node,
+                    f"jitted function '{fn.name}' takes control argument "
+                    f"'{name}' (str/bool/dict): declare it in "
+                    "static_argnames or bind it in functools.partial — "
+                    "passed traced, it either fails (str/dict) or "
+                    "silently devolves branches (bool)"))
+    return findings
+
+
+# ---- rule: host-sync -------------------------------------------------------
+
+#: models/tsne.py functions that run inside (or per-iteration around) the
+#: compiled optimize loop; the rest of the module is host orchestration
+TSNE_HOT_FUNCS = {
+    "optimize", "_gradient", "_attractive_forces",
+    "_attractive_forces_edges", "_update_embedding", "_center",
+    "_global_mean", "_psum", "center_input",
+}
+
+_SYNC_NUMPY_FUNCS = ("asarray", "array")
+
+
+def _walk_own_body(fn: ast.FunctionDef):
+    """Walk ``fn`` without descending into nested defs (those are visited
+    under their own qualname by :func:`_functions_with_parents`)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _host_sync_calls(fn: ast.FunctionDef, np_names: set[str]):
+    """(node, what) for each host-sync call inside ``fn``'s own body."""
+    for node in _walk_own_body(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                yield node, ".item()"
+            elif func.attr == "block_until_ready":
+                yield node, "block_until_ready"
+            elif (func.attr in _SYNC_NUMPY_FUNCS
+                  and _is_name_in(func.value, np_names)):
+                yield node, f"np.{func.attr}"
+        elif (isinstance(func, ast.Name) and func.id == "float"
+              and len(node.args) == 1
+              and isinstance(node.args[0],
+                             (ast.Name, ast.Attribute, ast.Subscript))):
+            # float(x) of a bare name/attribute/subscript is the classic
+            # device-scalar pull; float(host arithmetic) is not flagged
+            yield node, "float()"
+
+
+@rule("host-sync",
+      ".item()/float()/np.asarray/block_until_ready in ops/ and the "
+      "models/tsne.py step/loop functions")
+def host_sync(project: Project):
+    findings = []
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        in_ops = "/ops/" in norm or norm.startswith("ops/")
+        is_tsne = norm.endswith("models/tsne.py")
+        if not (in_ops or is_tsne):
+            continue
+        np_names = _import_aliases(mod.tree, "numpy")
+        for fn, qual in _functions_with_parents(mod.tree):
+            if is_tsne and qual.split(".")[0] not in TSNE_HOT_FUNCS:
+                continue
+            for node, what in _host_sync_calls(fn, np_names):
+                findings.append(mod.finding(
+                    "host-sync", node,
+                    f"{what} in hot path '{qual}': a device->host sync "
+                    "stalls the pipeline; hoist it out of the hot path or "
+                    "suppress with the rationale (deliberate timing/"
+                    "dispatch sync points qualify)"))
+    return findings
+
+
+# ---- rule: dtype-drift -----------------------------------------------------
+
+def _has_float_literal(node) -> bool:
+    return any(isinstance(sub, ast.Constant) and isinstance(sub.value, float)
+               for sub in ast.walk(node))
+
+
+@rule("dtype-drift",
+      "dtype-less jnp.array/jnp.asarray of float literals and bare "
+      "np.float64 in ops/ (silent f64 upcasts under x64)")
+def dtype_drift(project: Project):
+    findings = []
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        if not ("/ops/" in norm or norm.startswith("ops/")):
+            continue
+        np_names = _import_aliases(mod.tree, "numpy")
+        jnp_names = (_import_aliases(mod.tree, "jax.numpy")
+                     | _from_import_aliases(mod.tree, "numpy")
+                     | {"jnp"})
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Attribute) and node.attr == "float64"
+                    and _is_name_in(node.value, np_names)):
+                findings.append(mod.finding(
+                    "dtype-drift", node,
+                    "bare np.float64 in ops/: under the x64 test config "
+                    "this upcasts the whole expression; thread the "
+                    "computation dtype instead"))
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("array", "asarray")
+                    and _is_name_in(node.func.value, jnp_names)
+                    and node.args):
+                continue
+            has_dtype = (len(node.args) >= 2
+                         or any(kw.arg == "dtype" for kw in node.keywords))
+            if not has_dtype and _has_float_literal(node.args[0]):
+                findings.append(mod.finding(
+                    "dtype-drift", node,
+                    f"dtype-less jnp.{node.func.attr} of a float literal: "
+                    "this silently becomes f64 under x64 (tier-1 runs "
+                    "jax_enable_x64) and f32 elsewhere — pass the "
+                    "computation dtype explicitly"))
+    return findings
+
+
+# ---- rule: bench-record-contract -------------------------------------------
+
+SCHEMA_CONST = "RECORD_BASE_KEYS"
+EMIT_FUNC = "_emit"
+
+
+def _dict_spreads(node: ast.Dict) -> set[str]:
+    """Names spread into a dict literal via ``**name``."""
+    return {v.id for k, v in zip(node.keys, node.values)
+            if k is None and isinstance(v, ast.Name)}
+
+
+def _dict_str_keys(node: ast.Dict) -> set[str]:
+    return {k.value for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+
+
+@rule("bench-record-contract",
+      "bench record emission sites carry the RECORD_BASE_KEYS schema")
+def bench_record_contract(project: Project):
+    findings = []
+    for mod in project.modules:
+        schema = None
+        schema_node = None
+        emits_defined = False
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == SCHEMA_CONST
+                            for t in node.targets)):
+                val = _literal(node.value)
+                if isinstance(val, (tuple, list)):
+                    schema = set(val)
+                    schema_node = node
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == EMIT_FUNC):
+                emits_defined = True
+        if not emits_defined and schema is None:
+            continue
+        if emits_defined and schema is None:
+            findings.append(mod.finding(
+                "bench-record-contract", mod.tree.body[0],
+                f"module defines {EMIT_FUNC}() but no {SCHEMA_CONST} "
+                "schema constant: declare the keys every record must "
+                "carry"))
+            continue
+        # (1) every dict literal assigned to a name called `base` carries
+        # every declared key
+        base_names = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "base" in targets and isinstance(node.value, ast.Dict):
+                base_names.add("base")
+                missing = (schema or set()) - _dict_str_keys(node.value)
+                if missing:
+                    findings.append(mod.finding(
+                        "bench-record-contract", node.value,
+                        "base record dict is missing declared key(s) "
+                        f"{sorted(missing)} from {SCHEMA_CONST}"))
+        if schema_node is not None and not base_names:
+            findings.append(mod.finding(
+                "bench-record-contract", schema_node,
+                f"{SCHEMA_CONST} declared but no `base = {{...}}` record "
+                "dict found to enforce it against"))
+        # (2) every _emit(x) argument spreads **base (directly, or via a
+        # name whose assignment spreads it)
+        spread_ok_names = set()
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Dict)
+                    and "base" in _dict_spreads(node.value)):
+                spread_ok_names.update(t.id for t in node.targets
+                                       if isinstance(t, ast.Name))
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == EMIT_FUNC and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Dict) and "base" in _dict_spreads(arg):
+                continue
+            if isinstance(arg, ast.Name) and (arg.id in spread_ok_names
+                                              or arg.id == "base"):
+                continue
+            findings.append(mod.finding(
+                "bench-record-contract", node,
+                f"{EMIT_FUNC}() argument does not spread the base record "
+                f"(**base): this emission site can drift from "
+                f"{SCHEMA_CONST}"))
+    return findings
+
+
+# ---- rule: cli-api-parity --------------------------------------------------
+
+#: flag -> kwarg spellings the camelCase->snake_case transform cannot derive
+FLAG_TO_KWARG = {"iterations": "n_iter"}
+
+#: job I/O and process-control flags: meaningful only for a CLI invocation,
+#: deliberately absent from the in-process estimator surface
+CLI_ONLY_FLAGS = {
+    "input", "output", "dimension", "inputDistanceMatrix", "executionPlan",
+    "loss", "checkpoint", "checkpointEvery", "resume", "fatCheckpoint",
+    "noCache", "profile", "coordinator", "numProcesses", "processId",
+}
+
+#: estimator-only kwargs with no CLI counterpart (none at present; the
+#: entry stays so adding one is a reviewed decision, not silent drift)
+API_ONLY_KWARGS: set = set()
+
+
+def _camel_to_snake(name: str) -> str:
+    return re.sub(r"(?<=[a-z0-9])([A-Z])",
+                  lambda m: "_" + m.group(1).lower(), name)
+
+
+def _parser_flags(fn: ast.FunctionDef):
+    """{flag_name: (default_literal_or_sentinel, required, lineno)} from the
+    ``add_argument`` calls of a parser-building function."""
+    flags = {}
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args):
+            continue
+        name = _const_str(node.args[0])
+        if not name or not name.startswith("--"):
+            continue
+        name = name[2:]
+        default = _literal  # sentinel: no literal default
+        required = False
+        for kw in node.keywords:
+            if kw.arg == "default":
+                default = _literal(kw.value)
+            elif kw.arg == "required":
+                required = _literal(kw.value) is True
+            elif (kw.arg == "action"
+                  and _const_str(kw.value) in ("store_true", "store_false")):
+                default = _const_str(kw.value) == "store_false"
+        flags[name] = (default, required, node.lineno)
+    return flags
+
+
+def _init_kwargs(cls: ast.ClassDef):
+    """{kwarg: (default_literal_or_sentinel, lineno)} from ``__init__``."""
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            args = node.args
+            pos = list(args.posonlyargs) + list(args.args)
+            pos = [a for a in pos if a.arg != "self"]
+            defaults = ([None] * (len(pos) - len(args.defaults))
+                        + list(args.defaults))
+            out = {}
+            for a, d in zip(pos, defaults):
+                out[a.arg] = (_literal if d is None else _literal(d),
+                              a.lineno)
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                out[a.arg] = (_literal if d is None else _literal(d),
+                              a.lineno)
+            return out
+    return {}
+
+
+@rule("cli-api-parity",
+      "argparse flags in build_parser match TSNE estimator kwargs "
+      "(presence and defaults)")
+def cli_api_parity(project: Project):
+    parser_mod = parser_fn = None
+    api_mod = api_cls = None
+    for mod in project.modules:
+        for node in mod.tree.body:
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == "build_parser"):
+                parser_mod, parser_fn = mod, node
+            if isinstance(node, ast.ClassDef) and node.name == "TSNE":
+                api_mod, api_cls = mod, node
+    if parser_fn is None or api_cls is None:
+        return []  # nothing to cross-check in this scan set
+    findings = []
+    flags = _parser_flags(parser_fn)
+    kwargs = _init_kwargs(api_cls)
+    seen_kwargs = set()
+    for flag, (default, required, lineno) in sorted(flags.items()):
+        if flag in CLI_ONLY_FLAGS:
+            continue
+        kwarg = FLAG_TO_KWARG.get(flag, _camel_to_snake(flag))
+        if kwarg not in kwargs:
+            findings.append(Finding(
+                "cli-api-parity", parser_mod.display, lineno, 0,
+                f"CLI flag --{flag} has no TSNE kwarg counterpart "
+                f"('{kwarg}'): add it to models/api.py, or add --{flag} "
+                "to CLI_ONLY_FLAGS with the rationale"))
+            continue
+        seen_kwargs.add(kwarg)
+        kw_default, _kw_line = kwargs[kwarg]
+        if required or default is _literal or kw_default is _literal:
+            continue
+        if default != kw_default or (isinstance(default, bool)
+                                     != isinstance(kw_default, bool)):
+            findings.append(Finding(
+                "cli-api-parity", parser_mod.display, lineno, 0,
+                f"default mismatch: CLI --{flag} defaults to {default!r} "
+                f"but TSNE(..., {kwarg}={kw_default!r}) — align them, or "
+                "state the continuity rationale in a suppression"))
+    for kwarg, (_, kw_line) in sorted(kwargs.items()):
+        if kwarg in seen_kwargs or kwarg in API_ONLY_KWARGS:
+            continue
+        findings.append(Finding(
+            "cli-api-parity", api_mod.display, kw_line, 0,
+            f"TSNE kwarg '{kwarg}' has no CLI flag counterpart: add the "
+            "flag to utils/cli.py, or add it to API_ONLY_KWARGS with the "
+            "rationale"))
+    return findings

@@ -30,11 +30,14 @@ class TSNE:
                  initial_momentum: float = 0.5, final_momentum: float = 0.8,
                  theta: float | None = None, repulsion: str = "auto",
                  knn_method: str = "bruteforce", neighbors: int | None = None,
-                 knn_blocks: int = 8, knn_iterations: int | None = None,
+                 knn_blocks: int | None = None,
+                 knn_iterations: int | None = None,
                  knn_refine: int | None = None, knn_autotune: bool = False,
                  random_state: int = 0,
                  spmd: bool = False, devices: int | None = None,
                  sym_mode: str = "replicated", attraction: str = "auto",
+                 sym_width: int | None = None, sym_slack: int | None = None,
+                 sym_strict: bool = False, bh_gate: str = "vdm",
                  dtype: str | None = None,
                  affinity_assembly: str | None = None,
                  cache_dir: str | None = None):
@@ -53,6 +56,8 @@ class TSNE:
         self.repulsion = repulsion
         self.knn_method = knn_method
         self.neighbors = neighbors
+        # None = the CLI's --knnBlocks default: one block per device
+        # (Tsne.scala:63), resolved at fit time (cli-api-parity rule)
         self.knn_blocks = knn_blocks
         self.knn_iterations = knn_iterations
         self.knn_refine = knn_refine
@@ -67,6 +72,17 @@ class TSNE:
         self.spmd = spmd
         self.devices = devices
         self.sym_mode = sym_mode
+        # symmetrization controls, CLI parity (--symWidth/--symSlack/
+        # --symStrict): sym_width pins the static P-row width in both
+        # pipelines; slack/strict steer the spmd alltoall symmetrization
+        self.sym_width = sym_width
+        self.sym_slack = sym_slack
+        self.sym_strict = sym_strict
+        # BH acceptance test, CLI parity (--bhGate): vdm (accurate,
+        # scale-free) | flink (reference parity, QuadTree.scala:134)
+        if bh_gate not in ("vdm", "flink"):
+            raise ValueError(f"bh_gate '{bh_gate}' not defined (vdm | flink)")
+        self.bh_gate = bh_gate
         # attraction-sweep layout — see ops/affinities.plan_edges; auto picks
         # the flat edge layout on hub-heavy graphs.  Validated HERE so a typo
         # fails at construction, not after the multi-minute kNN stage
@@ -117,7 +133,7 @@ class TSNE:
             repulsion=pick_repulsion(self.repulsion, self.theta, n,
                                      self.n_components,
                                      self.theta_explicit_),
-            attraction=self.attraction)
+            attraction=self.attraction, bh_gate=self.bh_gate)
 
     def fit(self, x, y=None) -> "TSNE":
         import jax
@@ -178,7 +194,10 @@ class TSNE:
             pipe = SpmdPipeline(cfg, n, d, k, knn_method=self.knn_method,
                                 knn_rounds=self.knn_iterations,
                                 knn_refine=self.knn_refine,
+                                sym_width=self.sym_width,
                                 sym_mode=self.sym_mode,
+                                sym_slack=self.sym_slack,
+                                sym_strict=self.sym_strict,
                                 n_devices=self.devices,
                                 artifact_cache=cache)
             if cache is not None and jax.process_count() == 1:
@@ -198,10 +217,12 @@ class TSNE:
         else:
             y, losses = tsne_embed(
                 x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
-                knn_blocks=self.knn_blocks,
+                knn_blocks=(self.knn_blocks if self.knn_blocks is not None
+                            else jax.device_count()),
                 knn_iterations=self.knn_iterations,
                 knn_refine=self.knn_refine,
                 knn_autotune=self.knn_autotune, seed=self.random_state,
+                sym_width=self.sym_width,
                 affinity_assembly=self.affinity_assembly,
                 artifact_cache=self._artifact_cache())
         self.embedding_ = np.asarray(y)

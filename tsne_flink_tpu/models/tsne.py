@@ -357,8 +357,8 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
         # (tsne_embed supports it) instead of affinity_pipeline's
         # row-layout demotion.  With no env either, 'auto' measures the
         # [N, S] footprint and protects hub-pathological graphs.
-        import os
-        affinity_assembly = os.environ.get("TSNE_AFFINITY_ASSEMBLY", "auto")
+        from tsne_flink_tpu.utils.env import env_str
+        affinity_assembly = env_str("TSNE_AFFINITY_ASSEMBLY")
     if affinity_assembly == "auto" and sym_width is not None:
         # an explicit pinned width IS a row-layout request (shape
         # stability / reproducing a prior layout) — auto must not ignore it
@@ -376,10 +376,14 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
     state = init_working_set(ikey, n, cfg.n_components, x.dtype)
     if extra is not None:
         # edges_extra must be STATIC (a python-level branch in _gradient)
+        # graftlint: disable=jit-hygiene -- one-shot full-schedule run, not
+        # a segment loop: nothing re-binds state, and tier-1's CPU backend
+        # cannot donate (it would warn on every call)
         run_blocks = jax.jit(partial(optimize, cfg=cfg, edges_extra=True))
         state, losses = run_blocks(state, jidx, jval, edges=extra)
         return state.y, losses
-    run = jax.jit(partial(optimize, cfg=cfg))
+    # graftlint: disable=jit-hygiene -- one-shot run, same rationale as above
+    run = jax.jit(partial(optimize, cfg=cfg, edges_extra=False))
     edges = None
     from tsne_flink_tpu.ops.affinities import assemble_edges, plan_edges
     use_edges, e_pad = plan_edges(jidx, jval, cfg.attraction)

@@ -35,6 +35,11 @@ ZERO_SUM_GUARD = 1e-7
 P_FLOOR = 1e-12  # the intended clamp at TsneHelpers.scala:191,194
 ATTRACTION_MODES = ("auto", "rows", "edges")  # plan_edges / CLI / bench
 
+#: bool control flags of the joint-distribution builders — always static
+#: under jit (the jit-hygiene lint rule): traced, they could not drive the
+#: Python branches that choose the return arity
+_BUILDER_STATIC = ("return_dropped", "return_needed", "return_row_deg")
+
 
 def _row_entropy(d, valid, beta, dtype):
     p = jnp.where(valid, jnp.exp(-d * beta), jnp.zeros((), dtype))
@@ -108,13 +113,15 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
     TPU-fast form; valid here because kNN rows have distinct ids).  Default
     comes from ``TSNE_AFFINITY_ASSEMBLY`` (else ``"sorted"``) so bench/CLI
     runs can A/B without a code change.  Returns (jidx, jval)."""
-    import os as _os
-
     import jax as _jax
     from functools import partial as _partial
 
     if assembly is None:
-        assembly = _os.environ.get("TSNE_AFFINITY_ASSEMBLY", "sorted")
+        from tsne_flink_tpu.utils.env import env_str
+        # call-site default 'sorted' (not the registry's 'auto'): this
+        # row-layout caller predates auto and keeps the golden-comparable
+        # builder for continuity — the demotions below handle the rest
+        assembly = env_str("TSNE_AFFINITY_ASSEMBLY", default="sorted")
         if assembly == "auto":
             # auto's memory protection needs the blocks return shape, which
             # this row-layout caller cannot consume — its rows are simply
@@ -136,13 +143,16 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
             "edge-direct blocks layout call affinity_blocks, which returns "
             "(jidx, jval, extra_edges)")
 
-    p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
+    p_cond = _jax.jit(pairwise_affinities, static_argnums=1,
+             static_argnames=("axis_name",))(dist, perplexity)
     if assembly == "split":
         if sym_width is None:
             w, rev = _jax.jit(_partial(split_width, return_rev=True))(
                 idx, p_cond)
             return _jax.jit(_partial(joint_distribution_split,
-                                     sym_width=int(w)))(idx, p_cond, rev=rev)
+                                     sym_width=int(w)),
+                            static_argnames=_BUILDER_STATIC)(
+                idx, p_cond, rev=rev)
         # an explicit sym_width was sized for SOME layout — possibly the
         # sorted one, whose lossless width differs from split's (the k
         # forward slots are reserved even on padded rows).  Never silently
@@ -153,7 +163,8 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
         rev = _jax.jit(reverse_merge)(idx, p_cond)
         jidx, jval, dropped, needed = _jax.jit(_partial(
             joint_distribution_split, sym_width=sym_width,
-            return_dropped=True, return_needed=True))(idx, p_cond, rev=rev)
+            return_dropped=True, return_needed=True),
+            static_argnames=("return_row_deg",))(idx, p_cond, rev=rev)
         if int(dropped) > 0:
             import sys as _sys
             print(f"# sym_width {sym_width} lossless for the sorted layout "
@@ -161,13 +172,13 @@ def affinity_pipeline(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
                   f"rerunning at its exact width {int(needed)}",
                   file=_sys.stderr)
             jidx, jval = _jax.jit(_partial(
-                joint_distribution_split,
-                sym_width=int(needed)))(idx, p_cond, rev=rev)
+                joint_distribution_split, sym_width=int(needed)),
+                static_argnames=_BUILDER_STATIC)(idx, p_cond, rev=rev)
         return jidx, jval
     if sym_width is None:
         sym_width = int(_jax.jit(symmetrized_width)(idx, p_cond))
-    return _jax.jit(_partial(joint_distribution, sym_width=sym_width))(
-        idx, p_cond)
+    return _jax.jit(_partial(joint_distribution, sym_width=sym_width),
+                    static_argnames=_BUILDER_STATIC)(idx, p_cond)
 
 
 def reverse_merge(idx: jnp.ndarray, p: jnp.ndarray,
@@ -346,16 +357,17 @@ def affinity_auto(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
     ``extra_edges=None`` and ``label='split-rows'`` for the row layout,
     else the blocks triple and ``label='blocks'`` (consume like
     :func:`affinity_blocks`)."""
-    import os as _os
     import sys as _sys
 
     import jax as _jax
     from functools import partial as _partial
 
     if rows_bytes_max is None:
-        rows_bytes_max = int(_os.environ.get("TSNE_ROWS_BYTES_MAX",
-                                             ROWS_BYTES_MAX))
-    p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
+        from tsne_flink_tpu.utils.env import env_int
+        rows_bytes_max = env_int("TSNE_ROWS_BYTES_MAX",
+                                 default=ROWS_BYTES_MAX)
+    p_cond = _jax.jit(pairwise_affinities, static_argnums=1,
+             static_argnames=("axis_name",))(dist, perplexity)
     w, rev = _jax.jit(_partial(split_width, return_rev=True))(idx, p_cond)
     w = int(w)
     n = int(idx.shape[0])
@@ -369,7 +381,9 @@ def affinity_auto(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float,
         # bench shape on CPU (results/profile_affinities_cpu.txt), and
         # sort/scatter-light where the on-chip sorted stage inverted 7-14x
         jidx, jval = _jax.jit(_partial(joint_distribution_split,
-                                       sym_width=w))(idx, p_cond, rev=rev)
+                                       sym_width=w),
+                              static_argnames=_BUILDER_STATIC)(
+            idx, p_cond, rev=rev)
         return jidx, jval, None, "split-rows"
     print(f"# affinity assembly auto: [N={n}, S={w}] rows need "
           f"{rows_bytes / 2**30:.1f} GiB (> {rows_bytes_max / 2**30:.1f}); "
@@ -389,7 +403,8 @@ def affinity_blocks(idx: jnp.ndarray, dist: jnp.ndarray, perplexity: float):
     edges_extra=True)`` / ``ShardedOptimizer(extra_edges=...)``."""
     import jax as _jax
 
-    p_cond = _jax.jit(pairwise_affinities, static_argnums=1)(dist, perplexity)
+    p_cond = _jax.jit(pairwise_affinities, static_argnums=1,
+             static_argnames=("axis_name",))(dist, perplexity)
     fwd_val, rsrc, rdst, rval = _jax.jit(symmetrize_split_blocks)(idx, p_cond)
     return idx, fwd_val, (rsrc, rdst, rval)
 

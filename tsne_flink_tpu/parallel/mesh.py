@@ -93,6 +93,10 @@ class ShardedOptimizer:
             return self._fns[key]
         cfg_ = self.cfg
         if self.n_devices == 1:
+            # graftlint: disable=jit-hygiene -- the segment-input state must
+            # NOT be donated: checkpoint_cb retains it between segments for
+            # the deadline-stop resume (bench.py cb keeps prog["state"]), so
+            # donation would hand XLA a buffer the host still reads
             fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters,
                                  edges_extra=edges_extra))
         else:

@@ -41,6 +41,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from tsne_flink_tpu.utils.env import env_int, env_raw
+
 MAGIC = "tsne_flink_tpu-artifact-v1"
 #: bump to invalidate every existing entry (layout/algorithm changes that
 #: alter the arrays without changing any fingerprint input).
@@ -61,7 +63,7 @@ ROW_LABELS = ("sorted", "split", "split-rows")
 def default_root() -> str:
     """Artifact root: $TSNE_ARTIFACT_DIR, else repo-local ``.tsne_artifacts``
     (sibling of the ``.jax_cache`` compilation cache)."""
-    root = os.environ.get("TSNE_ARTIFACT_DIR")
+    root = env_raw("TSNE_ARTIFACT_DIR")
     if root:
         return root
     return os.path.join(os.path.dirname(os.path.dirname(
@@ -295,7 +297,7 @@ def prepare_fingerprints(x=None, knn=None, *, neighbors: int,
             metric=metric, rounds=rounds, refine=refine, blocks=knn_blocks,
             key_data=key_data, dtype=np.asarray(x[:0]).dtype)
     import tsne_flink_tpu.ops.affinities as aff
-    rbm = int(os.environ.get("TSNE_ROWS_BYTES_MAX", aff.ROWS_BYTES_MAX))
+    rbm = env_int("TSNE_ROWS_BYTES_MAX", default=aff.ROWS_BYTES_MAX)
     affinity_fp = affinity_fingerprint(knn_fp, perplexity=perplexity,
                                        assembly=assembly,
                                        sym_width=sym_width,

@@ -21,6 +21,8 @@ import hashlib
 import os
 import platform
 
+from tsne_flink_tpu.utils.env import env_raw
+
 
 def host_signature() -> str:
     """12-hex digest of this machine's CPU feature set + arch + python ABI.
@@ -69,7 +71,7 @@ def enable_compilation_cache(path: str | None = None) -> None:
     import jax
 
     if path is None:
-        root = os.environ.get("TSNE_TPU_CACHE_DIR")
+        root = env_raw("TSNE_TPU_CACHE_DIR")
         if root is None:
             root = _default_root()
             # sweep ONLY the repo-default root — a user-supplied

@@ -17,6 +17,8 @@ import os
 import sys
 import time
 
+from tsne_flink_tpu.utils.env import env_bool, env_str
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -312,7 +314,7 @@ def _main(argv=None) -> int:
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
 
-    if os.environ.get("TSNE_FORCE_CPU", "").lower() not in ("", "0", "false"):
+    if env_bool("TSNE_FORCE_CPU"):
         # dev/test escape hatch: the container's sitecustomize latches the
         # accelerator platform before env vars are read, so pin via config
         import jax as _jax
@@ -361,8 +363,7 @@ def _main(argv=None) -> int:
         raise SystemExit(f"--affinityAssembly {args.affinityAssembly} has "
                          "no effect with --spmd (symmetrization is chosen "
                          "by --symMode there); drop the flag")
-    assembly = (args.affinityAssembly
-                or os.environ.get("TSNE_AFFINITY_ASSEMBLY", "auto"))
+    assembly = args.affinityAssembly or env_str("TSNE_AFFINITY_ASSEMBLY")
     if assembly not in ("auto", "sorted", "split", "blocks"):
         raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
                          "(auto | sorted | split | blocks)")
@@ -436,7 +437,7 @@ def _main(argv=None) -> int:
     # so only the FIRST run of a (data, plan) pays the prepare stage.
     # An explicit --cacheDir re-enables over $TSNE_ARTIFACTS=0.
     from tsne_flink_tpu.utils import artifacts as art
-    env_off = os.environ.get("TSNE_ARTIFACTS", "1").lower() in ("0", "false")
+    env_off = not env_bool("TSNE_ARTIFACTS")
     art_cache = None
     if not args.noCache and (args.cacheDir is not None or not env_off):
         art_cache = art.ArtifactCache(args.cacheDir)

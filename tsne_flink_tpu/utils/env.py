@@ -1,0 +1,236 @@
+"""Typed registry of every ``TSNE_*`` environment variable.
+
+Nineteen-plus ``TSNE_*`` knobs grew up ad-hoc across ``bench.py``, the CLI,
+the caches and the scripts, each re-implementing its own truthiness parse
+(``not in ("", "0", "false")`` in four spellings) and its own default.  The
+reference's Flink job had ``ParameterTool`` as the single typed front door
+for configuration; this module is that front door for the environment:
+
+* every variable is **declared once** — name, type, default, help — and the
+  ``env-registry`` rule of :mod:`tsne_flink_tpu.analysis` makes raw
+  ``os.environ`` / ``os.getenv`` reads of ``TSNE_*`` names (and uses of
+  undeclared names) lint findings, so a new knob cannot ship undocumented;
+* reads share ONE parse per type (``env_bool`` treats ``0/false/no/off`` as
+  false, empty-as-unset, anything else as true — a superset of every parse
+  it replaced);
+* ``python -m tsne_flink_tpu.analysis --env-table`` renders the registry as
+  the README's environment-variable table, so docs regenerate from code.
+
+Pure stdlib on purpose: the analyzer (and anything else that wants the
+declarations) can import this without JAX.
+
+Call-site defaults: ``default=`` at the call site overrides the registry
+default — for the few knobs whose default is context-dependent (e.g.
+``TSNE_ROWS_BYTES_MAX`` defaults to ``ops.affinities.ROWS_BYTES_MAX``,
+``TSNE_FORCE_CPU`` defaults ON inside ``scripts/run_large_n.py``).  The
+registry row documents the canonical default.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = [
+    "EnvVar", "declared_vars", "env_bool", "env_float", "env_int",
+    "env_raw", "env_setdefault", "env_str", "env_table_markdown",
+]
+
+_UNSET = object()
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared environment variable."""
+
+    name: str
+    type: str          # bool | int | float | str | path
+    default: object    # canonical default (None = unset / caller-supplied)
+    help: str
+    choices: tuple = ()
+
+
+_REGISTRY: dict[str, EnvVar] = {}
+
+
+def _declare(name: str, type: str, default, help: str,
+             choices: tuple = ()) -> None:
+    _REGISTRY[name] = EnvVar(name, type, default, help, choices)
+
+
+# ---- backend / precision --------------------------------------------------
+_declare("TSNE_FORCE_CPU", "bool", False,
+         "Pin JAX to the CPU backend (dev/test escape hatch; the container's "
+         "sitecustomize latches the accelerator before env vars are read, so "
+         "entry points honor this via jax.config). The bench retry wrapper "
+         "sets it for its CPU-fallback child. scripts/run_large_n.py "
+         "defaults it ON (its virtual 8-device mesh is CPU-only).")
+_declare("TSNE_MATMUL_F32", "bool", False,
+         "Pin pure-float32 matmul operands on TPU (A/B evidence runs). "
+         "Default: a defaulted-f32 run on TPU feeds bf16 operands "
+         "(ops/metrics.default_matmul_dtype; quality pinned "
+         "indistinguishable).")
+_declare("TSNE_QUALITY_BACKEND", "str", "cpu",
+         "Backend the quality scripts (scripts/validate_quality.py, "
+         "scripts/quality_60k.py) pin via jax_platforms.")
+
+# ---- affinity / kNN stage knobs -------------------------------------------
+_declare("TSNE_AFFINITY_ASSEMBLY", "str", "auto",
+         "Default symmetrized-P builder when --affinityAssembly / "
+         "affinity_assembly is not given. Row-layout-only callers "
+         "(ops/affinities.affinity_pipeline) default to 'sorted' and demote "
+         "'blocks' to the equivalent 'split'.",
+         choices=("auto", "sorted", "split", "blocks"))
+_declare("TSNE_ROWS_BYTES_MAX", "int", None,
+         "Byte budget assembly='auto' allows the [N, S] row layout before "
+         "switching to the memory-flat blocks layout. Default: "
+         "ops.affinities.ROWS_BYTES_MAX (4 GiB).")
+_declare("TSNE_KNN_AUTOTUNE", "bool", False,
+         "Empirically autotune the kNN refine tile plan on a row slice "
+         "before the kNN stage (the CLI's --knnAutotune; recall-invariant "
+         "by construction).")
+
+# ---- caches ----------------------------------------------------------------
+_declare("TSNE_ARTIFACTS", "bool", True,
+         "Prepare-artifact cache (utils/artifacts.py) on/off for bench/CLI "
+         "runs. 0/false disables; an explicit --cacheDir re-enables.")
+_declare("TSNE_ARTIFACT_DIR", "path", None,
+         "Prepare-artifact cache root. Default: repo-local "
+         ".tsne_artifacts.")
+_declare("TSNE_TPU_CACHE_DIR", "path", None,
+         "Persistent XLA compilation cache root (utils/cache.py). Default: "
+         "repo-local .jax_cache (which also gets the legacy-entry sweep).")
+_declare("TSNE_TPU_NATIVE_CACHE", "path", None,
+         "Build directory for the ctypes native CSV runtime "
+         "(utils/native.py). Default: tsne_flink_tpu/native/build.")
+
+# ---- bench window-proofing (bench.py) --------------------------------------
+_declare("TSNE_BENCH_T0", "float", None,
+         "First-entry wall-clock of the bench invocation, pinned via "
+         "setdefault so the retry wrapper's children share one deadline "
+         "clock. Internal; set it only to backdate the clock in tests.")
+_declare("TSNE_BENCH_DEADLINE_S", "float", 570.0,
+         "Bench deadline in seconds, measured from TSNE_BENCH_T0; the "
+         "optimize loop stops segmenting and extrapolates when the next "
+         "segment would cross it.")
+_declare("TSNE_BENCH_MARGIN_S", "float", 20.0,
+         "Safety margin subtracted from the remaining bench window when "
+         "deciding whether another optimize segment fits.")
+_declare("TSNE_BENCH_SEG", "int", 0,
+         "Fixed optimize segment size in iterations; 0 = auto "
+         "(max(LOSS_EVERY, min(50, iters // 10))).")
+_declare("TSNE_BENCH_INIT_TIMEOUT", "float", 60.0,
+         "Seconds the backend watchdog waits for jax.devices() before "
+         "declaring the accelerator tunnel unavailable (exit code 3).")
+_declare("TSNE_BENCH_INIT_RETRIES", "int", 1,
+         "How many child attempts the bench retry wrapper makes before the "
+         "CPU fallback.")
+_declare("TSNE_BENCH_INIT_BACKOFF", "float", 30.0,
+         "Base seconds between bench retry-wrapper attempts (linear "
+         "backoff: attempt i waits i * backoff).")
+_declare("TSNE_BENCH_CPU_FALLBACK", "bool", True,
+         "After the retries, run a final CPU-pinned bench child (records "
+         "clearly labeled backend=cpu) instead of recording nothing. "
+         "0/false fails hard instead.")
+_declare("TSNE_BENCH_WRAPPED", "bool", False,
+         "Set by the retry wrapper on its children so they run the bench "
+         "body instead of re-entering the wrapper.")
+_declare("TSNE_TUNNEL_DOWN", "bool", False,
+         "Set by the retry wrapper for the CPU-fallback child: every record "
+         "of that run carries tunnel_down=true plus the path of the latest "
+         "mirrored on-chip record (VERDICT r5 item 9).")
+
+
+def declared_vars() -> tuple[EnvVar, ...]:
+    """Every declared variable, sorted by name (docs/table order)."""
+    return tuple(sorted(_REGISTRY.values(), key=lambda v: v.name))
+
+
+def _lookup(name: str) -> EnvVar:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"environment variable '{name}' is not declared in "
+            "tsne_flink_tpu/utils/env.py — add an EnvVar entry (the "
+            "env-registry lint rule enforces this)") from None
+
+
+def _resolve_default(var: EnvVar, default):
+    return var.default if default is _UNSET else default
+
+
+def env_raw(name: str, default=_UNSET):
+    """The raw string value, or the (registry or call-site) default when
+    unset.  The one read primitive every typed getter goes through."""
+    var = _lookup(name)
+    val = os.environ.get(name)
+    if val is None:
+        return _resolve_default(var, default)
+    return val
+
+
+def env_str(name: str, default=_UNSET):
+    """String read; validates against the declaration's ``choices``
+    (pre-parse fail-fast is the caller's job — this only normalizes)."""
+    val = env_raw(name, default)
+    return val if val is None else str(val)
+
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def env_bool(name: str, default=_UNSET) -> bool:
+    """One truthiness parse for every flag: 0/false/no/off (any case) is
+    False, empty/unset is the default, anything else is True — a superset
+    of each ad-hoc ``not in ("", "0", "false")`` spelling it replaced."""
+    var = _lookup(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return bool(_resolve_default(var, default))
+    return raw.lower() not in _FALSY
+
+
+def env_int(name: str, default=_UNSET):
+    raw = env_raw(name, default)
+    if raw is None or isinstance(raw, int):
+        return raw
+    try:
+        return int(str(raw), 0)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+
+
+def env_float(name: str, default=_UNSET):
+    raw = env_raw(name, default)
+    if raw is None or isinstance(raw, float):
+        return raw
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not a number") from None
+
+
+def env_setdefault(name: str, value) -> str:
+    """``os.environ.setdefault`` through the registry: pin ``name`` to
+    ``value`` (stringified) unless already set, and return the effective
+    raw string — the bench's shared-deadline-clock (TSNE_BENCH_T0)
+    pattern, inherited by child processes via the environment."""
+    _lookup(name)
+    return os.environ.setdefault(name, str(value))
+
+
+def env_table_markdown() -> str:
+    """The registry as a GitHub-markdown table (README's env-var section;
+    regenerate with ``python -m tsne_flink_tpu.analysis --env-table``)."""
+    rows = ["| Variable | Type | Default | Description |",
+            "| --- | --- | --- | --- |"]
+    for var in declared_vars():
+        default = "—" if var.default is None else repr(var.default)
+        help_text = var.help
+        if var.choices:
+            help_text += f" Choices: {', '.join(var.choices)}."
+        help_text = " ".join(help_text.split())
+        rows.append(f"| `{var.name}` | {var.type} | `{default}` "
+                    f"| {help_text} |")
+    return "\n".join(rows)

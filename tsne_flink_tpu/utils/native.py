@@ -19,6 +19,8 @@ import threading
 
 import numpy as np
 
+from tsne_flink_tpu.utils.env import env_raw
+
 _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native", "fastcsv.cpp")
 _LOCK = threading.Lock()
@@ -27,8 +29,8 @@ _TRIED = False
 
 
 def _build_dir() -> str:
-    d = os.environ.get("TSNE_TPU_NATIVE_CACHE",
-                       os.path.join(os.path.dirname(_SRC), "build"))
+    d = env_raw("TSNE_TPU_NATIVE_CACHE",
+                default=os.path.join(os.path.dirname(_SRC), "build"))
     os.makedirs(d, exist_ok=True)
     return d
 
