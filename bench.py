@@ -78,6 +78,7 @@ RECORD_BASE_KEYS = (
     "metric", "unit", "backend", "devices", "n", "iterations", "repulsion",
     "theta", "knn_rounds", "knn_refine", "data", "data_seed", "peak_flops",
     "peak_flops_basis", "assembly", "cache", "matmul_dtype", "knn_tiles",
+    "audit",
 )
 
 
@@ -336,6 +337,31 @@ def main():
     kind = jax.devices()[0].device_kind if backend == "tpu" else ""
     peak, basis = peak_flops(backend, kind, jax.device_count())
 
+    # optimize segment size, needed up front so the compile-count audit
+    # mirrors the segmentation this run will actually use (consumed again
+    # by the segmented optimize loop below)
+    seg = env_int("TSNE_BENCH_SEG") or max(
+        LOSS_EVERY, min(50, iters // 10 or iters))
+
+    # graftcheck plan audit (tsne_flink_tpu/analysis/audit/): the static
+    # per-stage peak-HBM estimate + implied compile count for THIS
+    # workload ride every record, so a future on-chip OOM or recompile
+    # storm is diagnosable against what the model predicted
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    from tsne_flink_tpu.analysis.audit.compile import plan_compile_count
+    from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
+    _plan = PlanConfig(n=n, d=d_in, k=k, backend=backend,
+                       iterations=iters, knn_rounds=rounds,
+                       knn_refine=refine, repulsion=repulsion,
+                       theta=theta, assembly=assembly,
+                       attraction=attraction, row_chunk=cfg.row_chunk,
+                       name="bench")
+    _hbm = plan_hbm_report(_plan)
+    audit_rec = {"peak_hbm_est": _hbm["peak_hbm_est"],
+                 "peak_stage": _hbm["peak_stage"],
+                 "hbm_budget": _hbm["hbm_budget"], "ok": _hbm["ok"],
+                 "compile_count": plan_compile_count(_plan, seg)}
+
     base = {
         "metric": "mnist60k_embed_seconds", "unit": "s",
         "backend": backend, "devices": jax.device_count(),
@@ -354,6 +380,8 @@ def main():
         # autotune overrode the model; deliberately NOT in the artifact
         # fingerprint (recall is pinned, not bit-identity across plans)
         "knn_tiles": tile_plan.as_record(),
+        # graftcheck plan audit: static peak-HBM + compile-count prediction
+        "audit": audit_rec,
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
@@ -447,8 +475,6 @@ def main():
     # executable — start_iter and the loss trace are traced arguments) with
     # a superseding record after each; stop when the next segment would
     # cross the deadline and extrapolate the rest
-    seg = env_int("TSNE_BENCH_SEG") or max(
-        LOSS_EVERY, min(50, iters // 10 or iters))
     margin = env_float("TSNE_BENCH_MARGIN_S")
     t2 = time.time()
     prog = {"it": 0, "state": state, "losses": None,

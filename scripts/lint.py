@@ -9,9 +9,12 @@ Usage:
   python scripts/lint.py              # human-readable findings
   python scripts/lint.py --json      # machine-readable findings
   python scripts/lint.py ops/knn.py  # explicit targets instead of defaults
+  python scripts/lint.py --audit     # graftcheck: the semantic audit tier
 
 Any extra arguments are passed through (``--rules``, ``--list-rules``,
-``--env-table``, paths).  No JAX import happens anywhere below.
+``--env-table``, ``--plan``, paths).  No JAX import happens on the lint
+paths; ``--audit`` hands over to graftcheck, which imports JAX (pinned to
+the CPU backend, abstract eval only).
 """
 
 import os
@@ -29,7 +32,8 @@ def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     os.chdir(REPO)  # targets and finding paths are repo-relative
     if not any(not a.startswith("-") for a in args) \
-            and "--list-rules" not in args and "--env-table" not in args:
+            and "--list-rules" not in args and "--env-table" not in args \
+            and "--audit" not in args:
         args += DEFAULT_TARGETS
     return lint_main(args)
 

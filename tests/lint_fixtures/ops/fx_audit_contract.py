@@ -1,0 +1,37 @@
+"""Seeded audit-contract violations (exercised by tests/test_lint.py).
+
+Ops jitted by name without a ``contract(...)`` entry in
+``tsne_flink_tpu/analysis/audit/contracts.py`` must be flagged; declared
+names, lambdas (their callees carry the contracts) and suppressed twins
+must stay silent.
+"""
+
+from functools import partial
+
+import jax
+
+
+def mystery_op(x):
+    return x * 2.0
+
+
+def optimize(x):
+    # shares its name with a declared registry entry -> covered, silent
+    return x
+
+
+@jax.jit
+def decorated_mystery(x):  # VIOLATION: @jax.jit-decorated, no contract
+    return x + 1.0
+
+
+run_bare = jax.jit(mystery_op)  # VIOLATION: jitted by name, no contract
+
+run_partial = jax.jit(partial(mystery_op))  # VIOLATION: same through partial
+
+run_declared = jax.jit(optimize)  # declared in the registry: silent
+
+run_lambda = jax.jit(lambda x: mystery_op(x))  # lambda target: silent
+
+# graftlint: disable=audit-contract -- seeded suppression twin
+run_suppressed = jax.jit(mystery_op)

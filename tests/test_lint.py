@@ -59,7 +59,7 @@ def test_repo_is_lint_clean():
 def test_all_rules_registered():
     assert set(RULES) == {"env-registry", "jit-hygiene", "host-sync",
                           "dtype-drift", "bench-record-contract",
-                          "cli-api-parity"}
+                          "cli-api-parity", "audit-contract"}
 
 
 # ---- every fixture violation is found, suppressions silence ---------------
@@ -71,6 +71,7 @@ FIXTURE_FOR_RULE = {
     "dtype-drift": os.path.join("ops", "fx_dtype_drift.py"),
     "bench-record-contract": "fx_bench_contract.py",
     "cli-api-parity": "fx_cli_parity.py",
+    "audit-contract": os.path.join("ops", "fx_audit_contract.py"),
 }
 
 

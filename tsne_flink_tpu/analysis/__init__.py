@@ -15,6 +15,13 @@ Rules live in :mod:`tsne_flink_tpu.analysis.rules`; the framework in
 :mod:`tsne_flink_tpu.analysis.core`.  To add a rule, write a
 ``@rule("name", "doc")`` function over the parsed :class:`~core.Project`
 and return :class:`~core.Finding` objects — see docs/ARCHITECTURE.md.
+
+``--audit`` switches to **graftcheck**, the semantic tier
+(:mod:`tsne_flink_tpu.analysis.audit`): static HBM/OOM prediction, dtype
+contracts, compile and sharding audits over the traced pipeline —
+abstract eval only, CPU backend, same JSON schema family.  Unlike the
+lint tier it imports JAX, so it lives behind the flag and this package's
+import stays JAX-free.
 """
 
 from tsne_flink_tpu.analysis.core import (  # noqa: F401

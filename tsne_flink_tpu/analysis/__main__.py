@@ -1,13 +1,17 @@
-"""``python -m tsne_flink_tpu.analysis`` — the graftlint CLI.
+"""``python -m tsne_flink_tpu.analysis`` — the graftlint / graftcheck CLI.
 
-Exit status: 0 = clean, 1 = findings, 2 = usage error.  Never imports JAX
-(pinned by tests/test_lint.py), so it runs in seconds anywhere the source
-tree exists.
+Exit status: 0 = clean, 1 = findings, 2 = usage error.  The lint paths
+never import JAX (pinned by tests/test_lint.py), so they run in seconds
+anywhere the source tree exists; ``--audit`` switches to the graftcheck
+semantic tier (:mod:`tsne_flink_tpu.analysis.audit`), which traces the
+real pipeline abstractly and therefore does import JAX — pinned to the
+CPU backend, eval_shape only, no data.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from tsne_flink_tpu.analysis import core
@@ -30,8 +34,22 @@ def main(argv=None) -> int:
     p.add_argument("--env-table", action="store_true",
                    help="print the env-var registry as a markdown table "
                         "(the README section is generated from this)")
+    p.add_argument("--audit", action="store_true",
+                   help="run graftcheck, the semantic audit tier: "
+                        "hbm-footprint, dtype-contract, compile-audit and "
+                        "sharding-contract over the repo's representative "
+                        "plans (imports JAX; CPU backend, abstract eval "
+                        "only)")
+    p.add_argument("--plan", action="append", default=None,
+                   help="(--audit) audit these PlanConfig JSON file(s) "
+                        "instead of the built-in representative plans")
+    p.add_argument("--analyzers", default=None,
+                   help="(--audit) comma-separated subset of the four "
+                        "analyzers to run")
     args = p.parse_args(argv)
 
+    if args.audit:
+        return _audit(args)
     if args.env_table:
         # stdlib-only import: the registry is deliberately JAX-free
         from tsne_flink_tpu.utils.env import env_table_markdown
@@ -51,6 +69,31 @@ def main(argv=None) -> int:
         print(core.render_json(findings, n_files))
     else:
         print(core.render_human(findings, n_files))
+    return 1 if findings else 0
+
+
+def _audit(args) -> int:
+    """The graftcheck entry: pin the CPU backend BEFORE jax loads (an
+    audit must never touch — or hang on — an accelerator tunnel), enable
+    x64 so weak-type f64 upcasts manifest in the traces, then run the
+    analyzers."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    from tsne_flink_tpu.analysis.audit import (PlanConfig,
+                                               render_audit_human,
+                                               render_audit_json, run_audit)
+    plans = None
+    if args.plan:
+        plans = [PlanConfig.from_json(path) for path in args.plan]
+    analyzers = ([a.strip() for a in args.analyzers.split(",") if a.strip()]
+                 if args.analyzers else None)
+    findings, report = run_audit(plans=plans, analyzers=analyzers)
+    if args.json:
+        print(render_audit_json(findings, report))
+    else:
+        print(render_audit_human(findings, report))
     return 1 if findings else 0
 
 

@@ -1,0 +1,119 @@
+"""graftcheck — the semantic static-analysis tier (ISSUE 4 tentpole).
+
+Where graftlint (:mod:`tsne_flink_tpu.analysis.rules`) proves SYNTACTIC
+contracts with ``ast`` alone, graftcheck proves SEMANTIC ones by tracing
+the real pipeline abstractly — ``jax.eval_shape`` / ``jax.make_jaxpr``
+over ShapeDtypeStructs, on the CPU backend, with no data and no device
+computation.  Four analyzers, one report format shared with graftlint:
+
+* ``hbm-footprint``     (:mod:`.hbm`)      — per-stage peak-HBM estimates
+  for a :class:`~.plan.PlanConfig`, gated against the device budget; the
+  recorded 1M single-chip OOM (16.12 G vs 15.75 G) is its regression
+  anchor.
+* ``dtype-contract``    (:mod:`.dtype`)    — every registered op
+  (:mod:`.contracts`) abstract-evaled against its declared in/out dtypes,
+  with an end-to-end f64-upcast scan and a bf16-matmul-path leak check.
+* ``compile-audit``     (:mod:`.compile`)  — jit cache keys implied by a
+  config, measured on the real segment runner; fails on per-segment /
+  per-cycle recompilation.
+* ``sharding-contract`` (:mod:`.sharding`) — the shard_map programs
+  traced against the mesh spec; every collective's axis name must be a
+  live mesh axis.
+
+Entry points: ``python -m tsne_flink_tpu.analysis --audit`` (and
+``scripts/lint.py --audit``) run the full repo audit; the CLI's
+``--auditPlan`` runs the plan-level analyzers for one launch and refuses
+a predicted OOM; ``bench.py`` embeds ``audit: {peak_hbm_est,
+compile_count}`` in every record.  ``tests/test_audit.py`` pins the repo
+audit-clean in tier-1.
+
+Unlike the rest of :mod:`tsne_flink_tpu.analysis`, this subpackage DOES
+import JAX — keep it out of the lint-only import path (the linter stays
+importable from a bare source tree; ``tests/test_lint.py`` pins that).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tsne_flink_tpu.analysis.audit.plan import (  # noqa: F401
+    HBM_BUDGET_BYTES, PlanConfig, bench_plan)
+
+ANALYZERS = ("hbm-footprint", "dtype-contract", "compile-audit",
+             "sharding-contract")
+
+
+def default_plans() -> list:
+    """The representative configs the repo audit walks: the 60k headline
+    bench shape on both backends and the committed 1M blocks plan (the
+    fixed form of the round-5 OOM; its failing twin lives in
+    tests/audit_fixtures/ and is only audited by the regression test —
+    the REPO must audit clean)."""
+    return [
+        bench_plan(backend="tpu"),
+        bench_plan(backend="cpu"),
+        PlanConfig(n=1_000_000, d=784, k=90, backend="tpu",
+                   assembly="blocks", sym_width=3608,
+                   name="1m-blocks-tpu"),
+    ]
+
+
+def run_audit(plans=None, analyzers=None) -> tuple[list, dict]:
+    """Run the selected analyzers; returns (findings, report)."""
+    from tsne_flink_tpu.analysis.audit import compile as compile_audit
+    from tsne_flink_tpu.analysis.audit import dtype as dtype_audit
+    from tsne_flink_tpu.analysis.audit import hbm as hbm_audit
+    from tsne_flink_tpu.analysis.audit import sharding as sharding_audit
+
+    plans = default_plans() if plans is None else list(plans)
+    selected = set(ANALYZERS if analyzers is None else analyzers)
+    unknown = selected - set(ANALYZERS)
+    if unknown:
+        raise SystemExit(f"unknown analyzer(s) {sorted(unknown)}; known: "
+                         f"{list(ANALYZERS)}")
+    findings: list = []
+    report: dict = {"plans": {p.name: p.as_dict() for p in plans}}
+    if "hbm-footprint" in selected:
+        f, rep = hbm_audit.audit_hbm(plans)
+        findings.extend(f)
+        report["hbm"] = rep
+    if "compile-audit" in selected:
+        f, rep = compile_audit.audit_compile(plans)
+        findings.extend(f)
+        report["compile"] = rep
+    if "dtype-contract" in selected:
+        f, rep = dtype_audit.audit_dtype()
+        findings.extend(f)
+        report["dtype"] = rep
+    if "sharding-contract" in selected:
+        f, rep = sharding_audit.audit_sharding()
+        findings.extend(f)
+        report["sharding"] = rep
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, report
+
+
+def render_audit_json(findings, report) -> str:
+    """Same JSON schema family as graftlint (findings/counts/ok) plus the
+    ``audit`` section with the per-analyzer reports."""
+    counts: dict = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps({"findings": [f.as_dict() for f in findings],
+                       "counts": counts, "analyzers": list(ANALYZERS),
+                       "ok": not findings, "audit": report}, indent=2)
+
+
+def render_audit_human(findings, report) -> str:
+    lines = [f.format() for f in findings]
+    hbm = report.get("hbm", {})
+    for name, rep in sorted(hbm.items()):
+        lines.append(
+            f"graftcheck: plan {name}: peak HBM est "
+            f"{rep['peak_hbm_est_gib']} GiB in '{rep['peak_stage']}' "
+            + ("(no budget)" if rep["hbm_budget"] is None else
+               f"vs {round(rep['hbm_budget'] / (1 << 30), 2)} GiB budget "
+               f"-> {'ok' if rep['ok'] else 'PREDICTED OOM'}"))
+    lines.append(f"graftcheck: {len(findings)} finding(s) across "
+                 f"{len(report.get('plans', {}))} plan(s)")
+    return "\n".join(lines)

@@ -1,0 +1,158 @@
+"""compile-audit — count the jit cache keys a plan implies, statically.
+
+A TPU pipeline's worst silent failure mode after OOM is recompilation in
+a loop: a stage whose jit key varies per segment or per iteration turns
+seconds of compute into minutes of XLA.  This analyzer proves the repo's
+segmentation and cycle reuse contracts on the REAL objects:
+
+* **segment keys** — replay the exact segmentation arithmetic of
+  ``ShardedOptimizer.__call__`` against a real instance, registering each
+  ``_segment_fn`` key without tracing anything, and count ``_fns``
+  entries.  A full run must cost 1 executable; a checkpointed run at most
+  2 (the regular segment + one ragged tail); doubling ``iterations`` must
+  NOT change the count (that would be per-segment recompilation).
+* **cycle reuse** — the decomposed hybrid kNN plan reuses ONE compiled
+  Z-round executable for every refine cycle because ``start_round``
+  enters the math only through ``it > 0`` (ops/knn.knn_project_refined).
+  The analyzer traces ``knn_project`` at two continuation start_rounds
+  and compares the jaxprs: if they ever diverge, each cycle would be its
+  own compile and the audit fails.
+* **plan compile count** — the total distinct executables one pipeline
+  invocation implies (kNN stage programs + affinity builders + optimize
+  segments), reported per plan and embedded in bench records as
+  ``audit.compile_count``.
+
+Everything traces abstractly (``jax.make_jaxpr`` on ShapeDtypeStructs) —
+no device work, no data.
+"""
+
+from __future__ import annotations
+
+from tsne_flink_tpu.analysis.core import Finding
+from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+
+RULE = "compile-audit"
+
+#: distinct jitted programs per affinity assembly, mirroring the dispatch
+#: in ops/affinities (affinity_pipeline / affinity_auto / affinity_blocks):
+#: every path jits the beta search once, plus its builder programs.
+_AFFINITY_PROGRAMS = {
+    "sorted": 3,      # pairwise_affinities, symmetrized_width, joint
+    "split": 3,       # pairwise_affinities, split_width(+rev), joint_split
+    "split-rows": 3,  # affinity_auto's row outcome (same three)
+    "blocks": 2,      # pairwise_affinities, symmetrize_split_blocks
+}
+
+
+def segment_keys(iterations: int, checkpoint_every: int = 0,
+                 start_iter: int = 0) -> int:
+    """Distinct optimize-segment executables for one run, measured on a
+    real ``ShardedOptimizer`` by replaying ``__call__``'s segmentation loop
+    (``parallel/mesh.py``) — ``_segment_fn`` registers the jit wrapper per
+    cache key without tracing, so this is exact and costs microseconds."""
+    from tsne_flink_tpu.models.tsne import TsneConfig
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+
+    cfg = TsneConfig(iterations=iterations)
+    opt = ShardedOptimizer(cfg, n=1024, n_devices=1)
+    total = cfg.iterations
+    seg = checkpoint_every if checkpoint_every else total - start_iter
+    it = start_iter
+    while it < total:
+        step = min(seg, total - it)
+        if step <= 0:
+            break
+        opt._segment_fn(step)
+        it += step
+    return len(opt._fns)
+
+
+def knn_stage_programs(plan: PlanConfig) -> int:
+    """Compiled executables the prepare stage's kNN dispatch launches
+    (utils/artifacts.prepare runs the hybrid DECOMPOSED): seed + cycle +
+    merge + refine for the refined hybrid — constant in the cycle count —
+    else the one fused program."""
+    if plan.knn_method != "project":
+        return 1
+    _rounds, refine = plan.resolved_knn()
+    return 4 if refine > 0 else 1
+
+
+def plan_compile_count(plan: PlanConfig, checkpoint_every: int = 0) -> int:
+    """Total distinct executables one pipeline invocation implies."""
+    aff = _AFFINITY_PROGRAMS[plan.resolved_assembly()]
+    return (knn_stage_programs(plan) + aff
+            + segment_keys(plan.iterations, checkpoint_every))
+
+
+def _cycle_jaxpr(start_round: int):
+    """Abstract trace of a 2-round Z-order continuation at ``start_round``
+    (the decomposed plan's per-cycle program)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.ops.knn import knn_project
+    from tsne_flink_tpu.ops.knn_tiles import KnnTilePlan
+
+    tiles = KnnTilePlan(row_chunk=128, col_block=1024, block=1024,
+                        refine_chunk=64)
+    x = jax.ShapeDtypeStruct((128, 16), jnp.float32)
+    key = jax.random.key(0)
+    return jax.make_jaxpr(
+        lambda xx, kk: knn_project(xx, 8, rounds=2, key=kk,
+                                   start_round=start_round,
+                                   tiles=tiles))(x, key)
+
+
+def audit_compile(plans) -> tuple[list[Finding], dict]:
+    findings: list[Finding] = []
+    report: dict = {}
+
+    # --- segmentation contract on the real optimizer ---
+    full = segment_keys(300)
+    ckpt = segment_keys(300, checkpoint_every=50)
+    ckpt2x = segment_keys(600, checkpoint_every=50)
+    resumed = segment_keys(300, checkpoint_every=50, start_iter=123)
+    report["segment_keys"] = {"full": full, "checkpointed": ckpt,
+                              "checkpointed_2x_iters": ckpt2x,
+                              "resumed": resumed}
+    mesh_py = "tsne_flink_tpu/parallel/mesh.py"
+    if full != 1:
+        findings.append(Finding(
+            RULE, mesh_py, 1, 0,
+            f"a full (uncheckpointed) optimize run compiles {full} segment "
+            "executables; the segmented runner must serve it with ONE"))
+    if ckpt > 2 or resumed > 2:
+        findings.append(Finding(
+            RULE, mesh_py, 1, 0,
+            f"a checkpointed/resumed run compiles {max(ckpt, resumed)} "
+            "segment executables (expected <= 2: the regular segment plus "
+            "one ragged tail) — the segment size varies per segment"))
+    if ckpt2x != ckpt:
+        findings.append(Finding(
+            RULE, mesh_py, 1, 0,
+            f"segment-executable count grows with iterations ({ckpt} at "
+            f"300 vs {ckpt2x} at 600, checkpoint_every=50) — per-segment "
+            "recompilation"))
+
+    # --- cycle-reuse contract on the traced kNN graph ---
+    j1 = str(_cycle_jaxpr(1))
+    j2 = str(_cycle_jaxpr(5))
+    report["knn_cycle_program_stable"] = j1 == j2
+    if j1 != j2:
+        findings.append(Finding(
+            RULE, "tsne_flink_tpu/ops/knn.py", 1, 0,
+            "knn_project's continuation program differs between "
+            "start_round=1 and start_round=5: the decomposed hybrid plan "
+            "would compile a fresh executable PER CYCLE instead of reusing "
+            "one (start_round must only enter the math through `it > 0`)"))
+
+    # --- per-plan totals ---
+    report["plans"] = {}
+    for plan in plans:
+        report["plans"][plan.name] = {
+            "compile_count": plan_compile_count(plan),
+            "compile_count_checkpointed": plan_compile_count(
+                plan, checkpoint_every=50),
+        }
+    return findings, report

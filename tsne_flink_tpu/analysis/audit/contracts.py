@@ -1,0 +1,264 @@
+"""Dtype contracts for the repo's jitted ops — the dtype-contract registry.
+
+Every op that gets jitted by name in ``ops/`` and ``models/`` (the
+``audit-contract`` graftlint rule enumerates the call sites) declares here
+what the dtype-contract auditor should hold it to:
+
+* ``out``      — dtypes of the op's flattened array outputs when fed the
+  registry's representative float32 inputs.  The f32 case is the contract
+  because it is the deployment case: f64 runs are the CPU golden config,
+  and the auditor's job is proving f64 can NEVER enter a defaulted f32
+  pipeline (weak-type upcasts under ``jax_enable_x64`` included — the
+  auditor traces under x64 precisely so those manifest).
+* ``matmul_dim`` — when set, the op's distance matmuls contract over this
+  feature dimension and must follow the mixed-precision operand setting
+  (``ops/metrics.set_matmul_dtype``): under bf16 mode the auditor re-traces
+  and fails on any f32xf32 ``dot_general`` contracting over that size — an
+  f32 leak into the bf16 matmul path, checked on the traced graph instead
+  of lexically.
+* ``trace=False`` — declared-only: the contract is recorded for the lint
+  rule but the op is not abstractly traced (currently only the Mosaic
+  Pallas kernel, whose lowering is probed at runtime by
+  ``mosaic_supported`` and which the XLA path shadows everywhere else).
+
+Declarations are plain ``contract(...)`` calls so the graftlint rule can
+enumerate them with ``ast`` alone — this module is only *imported* by the
+audit tier (it builds JAX abstract values), never by the linter.
+
+Representative shapes are deliberately small (tracing cost only — shapes
+do not change dtype semantics) but chosen to engage every funnel stage:
+``D = 320`` turns on both the 32-dim JL filter and the 128-dim cascade
+(``pick_knn_filter`` / ``pick_knn_cascade``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+N, D, K, M = 192, 320, 12, 2
+S = 2 * K  # symmetrized row width used for optimizer-shaped inputs
+
+
+@dataclass(frozen=True)
+class OpContract:
+    name: str                 # dotted registry key; last segment = def name
+    path: str                 # repo-relative file, for findings
+    out: tuple                # expected output dtypes (flattened, in order)
+    make: object = None       # () -> (fn, args) with ShapeDtypeStruct args
+    matmul_dim: int | None = None
+    trace: bool = True
+
+
+REGISTRY: dict[str, OpContract] = {}
+
+
+def contract(name: str, path: str, out: tuple, make=None,
+             matmul_dim: int | None = None, trace: bool = True) -> None:
+    REGISTRY[name] = OpContract(name, path, tuple(out), make, matmul_dim,
+                                trace)
+
+
+def declared_names() -> set:
+    """Bare function names with a contract (what the lint rule checks)."""
+    return {c.name.rsplit(".", 1)[-1] for c in REGISTRY.values()}
+
+
+# ---- representative abstract inputs ----------------------------------------
+
+def _f32(*shape):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _i32(*shape):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def _key():
+    import jax
+    return jax.random.key(0)
+
+
+def _graph_args():
+    """(idx, p) pair shaped like a calibrated kNN graph."""
+    return _i32(N, K), _f32(N, K)
+
+
+# ---- ops/metrics.py ---------------------------------------------------------
+
+def _mk_pairwise():
+    from tsne_flink_tpu.ops.metrics import pairwise
+    return (lambda a, b: pairwise("sqeuclidean", a, b),
+            (_f32(64, D), _f32(96, D)))
+
+
+contract("ops.metrics.pairwise", "tsne_flink_tpu/ops/metrics.py",
+         ("float32",), _mk_pairwise, matmul_dim=D)
+
+
+# ---- ops/zorder.py ----------------------------------------------------------
+
+def _mk_zorder():
+    from tsne_flink_tpu.ops.zorder import zorder_permutation
+    return zorder_permutation, (_f32(N, 3),)
+
+
+contract("ops.zorder.zorder_permutation", "tsne_flink_tpu/ops/zorder.py",
+         ("int32",), _mk_zorder)
+
+
+# ---- ops/knn.py -------------------------------------------------------------
+
+def _mk_bruteforce():
+    from tsne_flink_tpu.ops.knn import knn_bruteforce
+    return lambda x: knn_bruteforce(x, K), (_f32(N, D),)
+
+
+def _mk_partition():
+    from tsne_flink_tpu.ops.knn import knn_partition
+    return lambda x: knn_partition(x, K, blocks=4), (_f32(N, D),)
+
+
+def _mk_project():
+    from tsne_flink_tpu.ops.knn import knn_project
+    return (lambda x, k: knn_project(x, K, rounds=2, key=k),
+            (_f32(N, D), _key()))
+
+
+def _mk_refine():
+    from tsne_flink_tpu.ops.knn import knn_refine
+    return (lambda x, i, d, k: knn_refine(x, i, d, rounds=1, key=k,
+                                          filter_dims=32),
+            (_f32(N, D), _i32(N, K), _f32(N, K), _key()))
+
+
+contract("ops.knn.knn_bruteforce", "tsne_flink_tpu/ops/knn.py",
+         ("int32", "float32"), _mk_bruteforce, matmul_dim=D)
+contract("ops.knn.knn_partition", "tsne_flink_tpu/ops/knn.py",
+         ("int32", "float32"), _mk_partition, matmul_dim=D)
+contract("ops.knn.knn_project", "tsne_flink_tpu/ops/knn.py",
+         ("int32", "float32"), _mk_project, matmul_dim=D)
+contract("ops.knn.knn_refine", "tsne_flink_tpu/ops/knn.py",
+         ("int32", "float32"), _mk_refine, matmul_dim=D)
+
+
+# ---- ops/affinities.py ------------------------------------------------------
+
+def _mk_pairwise_affinities():
+    from tsne_flink_tpu.ops.affinities import pairwise_affinities
+    return lambda d: pairwise_affinities(d, 4.0), (_f32(N, K),)
+
+
+def _mk_joint():
+    from tsne_flink_tpu.ops.affinities import joint_distribution
+    return (lambda i, p: joint_distribution(i, p, sym_width=S),
+            _graph_args())
+
+
+def _mk_joint_split():
+    from tsne_flink_tpu.ops.affinities import joint_distribution_split
+    return (lambda i, p: joint_distribution_split(i, p, sym_width=S),
+            _graph_args())
+
+
+def _mk_split_width():
+    from tsne_flink_tpu.ops.affinities import split_width
+    return split_width, _graph_args()
+
+
+def _mk_symmetrized_width():
+    from tsne_flink_tpu.ops.affinities import symmetrized_width
+    return symmetrized_width, _graph_args()
+
+
+def _mk_reverse_merge():
+    from tsne_flink_tpu.ops.affinities import reverse_merge
+    return reverse_merge, _graph_args()
+
+
+def _mk_split_blocks():
+    from tsne_flink_tpu.ops.affinities import symmetrize_split_blocks
+    return symmetrize_split_blocks, _graph_args()
+
+
+def _mk_assemble_edges():
+    from tsne_flink_tpu.ops.affinities import assemble_edges
+    return (lambda ji, jv: assemble_edges(ji, jv, e_pad=N * K),
+            (_i32(N, S), _f32(N, S)))
+
+
+_AFF = "tsne_flink_tpu/ops/affinities.py"
+contract("ops.affinities.pairwise_affinities", _AFF, ("float32",),
+         _mk_pairwise_affinities)
+contract("ops.affinities.joint_distribution", _AFF, ("int32", "float32"),
+         _mk_joint)
+contract("ops.affinities.joint_distribution_split", _AFF,
+         ("int32", "float32"), _mk_joint_split)
+contract("ops.affinities.split_width", _AFF, ("int32",), _mk_split_width)
+contract("ops.affinities.symmetrized_width", _AFF, ("int32",),
+         _mk_symmetrized_width)
+contract("ops.affinities.reverse_merge", _AFF, ("float32",),
+         _mk_reverse_merge)
+contract("ops.affinities.symmetrize_split_blocks", _AFF,
+         ("float32", "int32", "int32", "float32"), _mk_split_blocks)
+contract("ops.affinities.assemble_edges", _AFF,
+         ("int32", "int32", "float32"), _mk_assemble_edges)
+
+
+# ---- ops/repulsion_*.py -----------------------------------------------------
+
+def _mk_exact():
+    from tsne_flink_tpu.ops.repulsion_exact import exact_repulsion
+    return lambda y: exact_repulsion(y, row_chunk=64), (_f32(N, M),)
+
+
+def _mk_bh():
+    from tsne_flink_tpu.ops.repulsion_bh import bh_repulsion
+    return lambda y: bh_repulsion(y, row_chunk=64), (_f32(N, M),)
+
+
+def _mk_fft():
+    from tsne_flink_tpu.ops.repulsion_fft import fft_repulsion
+    return lambda y: fft_repulsion(y, grid=64), (_f32(N, M),)
+
+
+contract("ops.repulsion_exact.exact_repulsion",
+         "tsne_flink_tpu/ops/repulsion_exact.py", ("float32", "float32"),
+         _mk_exact)
+contract("ops.repulsion_bh.bh_repulsion",
+         "tsne_flink_tpu/ops/repulsion_bh.py", ("float32", "float32"),
+         _mk_bh)
+contract("ops.repulsion_fft.fft_repulsion",
+         "tsne_flink_tpu/ops/repulsion_fft.py", ("float32", "float32"),
+         _mk_fft)
+
+# Mosaic Pallas kernel: declared-only (trace=False) — its lowering is
+# hardware-gated and probed at runtime (ops/repulsion_pallas.mosaic_supported);
+# the XLA exact path above carries the same contract everywhere else.
+contract("ops.repulsion_pallas._run",
+         "tsne_flink_tpu/ops/repulsion_pallas.py", ("float32", "float32"),
+         trace=False)
+
+
+# ---- models/tsne.py ---------------------------------------------------------
+
+def _mk_optimize(repulsion: str):
+    def make():
+        from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+        cfg = TsneConfig(iterations=20, repulsion=repulsion,
+                         row_chunk=64)
+        state = TsneState(y=_f32(N, M), update=_f32(N, M), gains=_f32(N, M))
+        return (lambda st, ji, jv: optimize(st, ji, jv, cfg),
+                (state, _i32(N, S), _f32(N, S)))
+    return make
+
+
+contract("models.tsne.optimize", "tsne_flink_tpu/models/tsne.py",
+         ("float32",) * 4, _mk_optimize("exact"))
+contract("models.tsne.optimize[bh]", "tsne_flink_tpu/models/tsne.py",
+         ("float32",) * 4, _mk_optimize("bh"))
+contract("models.tsne.optimize[fft]", "tsne_flink_tpu/models/tsne.py",
+         ("float32",) * 4, _mk_optimize("fft"))

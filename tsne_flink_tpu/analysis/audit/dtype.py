@@ -1,0 +1,166 @@
+"""dtype-contract — abstract-eval every registered op and hold it to its
+declared dtypes.
+
+Three checks per registry entry (:mod:`.contracts`), all on abstract
+values only (``jax.eval_shape`` / ``jax.make_jaxpr`` — nothing executes,
+no data exists, runs on the CPU backend):
+
+1. **output dtypes** — the op fed its representative float32 inputs must
+   produce exactly its declared output dtypes;
+2. **f64 scan** — no float64 abstract value may appear ANYWHERE in the
+   traced graph (sub-jaxprs included).  Run under ``jax_enable_x64`` this
+   catches the weak-type upcasts the lexical dtype-drift rule cannot see:
+   a dtype-less float constructor or default-dtype RNG draw that silently
+   becomes f64 under the x64 test config shows up as an f64 aval in the
+   jaxpr, wherever it came from;
+3. **bf16 matmul path** — for ops with ``matmul_dim`` set, re-trace under
+   ``set_matmul_dtype(bfloat16)`` and fail on any ``dot_general`` that
+   contracts over the feature dimension with float32 operands (an f32
+   leak into the 2x-rate MXU path), and on any output dtype change (bf16
+   leaking OUT past the ``preferred_element_type`` accumulation
+   contract).
+
+The x64 flag is NOT toggled here: the in-process callers (tier-1 tests)
+already run under it, and the standalone audit entry point enables it
+before tracing.  When it is off the f64 scan still runs but can only see
+explicit f64 — the report records which mode produced it.
+"""
+
+from __future__ import annotations
+
+from tsne_flink_tpu.analysis.core import Finding
+from tsne_flink_tpu.analysis.audit.contracts import REGISTRY, OpContract
+
+RULE = "dtype-contract"
+
+
+def _iter_jaxprs(jaxpr):
+    """The jaxpr and every sub-jaxpr reachable through eqn params."""
+    seen = []
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        core_j = getattr(j, "jaxpr", j)  # ClosedJaxpr -> Jaxpr
+        if id(core_j) in (id(s) for s in seen):
+            continue
+        seen.append(core_j)
+        yield core_j
+        for eqn in core_j.eqns:
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else (v,)
+                for item in vals:
+                    if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                        stack.append(item)
+
+
+def _dtype_names(tree_leaves) -> list[str]:
+    return [str(leaf.dtype) for leaf in tree_leaves]
+
+
+def _f64_eqns(jaxpr):
+    """(primitive_name, dtype) for every eqn producing a float64 value."""
+    out = []
+    for j in _iter_jaxprs(jaxpr):
+        for eqn in j.eqns:
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is not None and str(getattr(aval, "dtype", "")) \
+                        == "float64":
+                    out.append(eqn.primitive.name)
+                    break
+    return out
+
+
+def _f32_feature_dots(jaxpr, dim: int):
+    """dot_general eqns contracting over size ``dim`` with f32 operands."""
+    leaks = []
+    for j in _iter_jaxprs(jaxpr):
+        for eqn in j.eqns:
+            if eqn.primitive.name != "dot_general":
+                continue
+            (lc, _rc), _ = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval
+            contract_sizes = {lhs.shape[i] for i in lc}
+            if dim in contract_sizes and str(lhs.dtype) == "float32":
+                leaks.append(eqn.primitive.name)
+    return leaks
+
+
+def audit_contract(c: OpContract) -> tuple[list[Finding], dict]:
+    """Run all three checks for one registry entry."""
+    import jax
+
+    findings: list[Finding] = []
+    rep: dict = {"out": None, "f64": 0, "bf16_checked": False}
+    if not c.trace or c.make is None:
+        rep["traced"] = False
+        return findings, rep
+    rep["traced"] = True
+    fn, args = c.make()
+
+    out = jax.eval_shape(fn, *args)
+    got = tuple(_dtype_names(jax.tree_util.tree_leaves(out)))
+    rep["out"] = got
+    if got != tuple(c.out):
+        findings.append(Finding(
+            RULE, c.path, 1, 0,
+            f"{c.name}: output dtypes {got} violate the declared contract "
+            f"{tuple(c.out)} (f32 inputs)"))
+
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    bad = _f64_eqns(jaxpr)
+    rep["f64"] = len(bad)
+    rep["x64"] = bool(jax.config.jax_enable_x64)
+    if bad:
+        findings.append(Finding(
+            RULE, c.path, 1, 0,
+            f"{c.name}: float64 values appear in the traced graph with f32 "
+            f"inputs (primitives: {sorted(set(bad))[:4]}) — a weak-type / "
+            "default-dtype upcast; thread the computation dtype"))
+
+    if c.matmul_dim is not None:
+        from tsne_flink_tpu.ops.metrics import (matmul_dtype,
+                                                set_matmul_dtype)
+        import jax.numpy as jnp
+        # a FRESH fn object for the bf16 trace: JAX caches traces by
+        # (fn identity, avals), and the matmul-dtype setting is invisible
+        # to that key — re-tracing the same object would return the f32
+        # graph and blind this check
+        fn16, args16 = c.make()
+        prev = matmul_dtype()
+        set_matmul_dtype(jnp.bfloat16)
+        try:
+            j16 = jax.make_jaxpr(fn16)(*args16)
+            out16 = jax.eval_shape(fn16, *args16)
+        finally:
+            set_matmul_dtype(prev)
+        rep["bf16_checked"] = True
+        leaks = _f32_feature_dots(j16, c.matmul_dim)
+        if leaks:
+            findings.append(Finding(
+                RULE, c.path, 1, 0,
+                f"{c.name}: {len(leaks)} dot_general(s) contract over the "
+                f"{c.matmul_dim}-wide feature axis with float32 operands "
+                "under the bf16 matmul setting — an f32 leak into the MXU "
+                "fast path (route operands through "
+                "ops/metrics.matmul_operands)"))
+        got16 = tuple(_dtype_names(jax.tree_util.tree_leaves(out16)))
+        if got16 != tuple(c.out):
+            findings.append(Finding(
+                RULE, c.path, 1, 0,
+                f"{c.name}: output dtypes change to {got16} under bf16 "
+                "matmul operands — accumulations must stay at the contract "
+                "dtypes (preferred_element_type)"))
+    return findings, rep
+
+
+def audit_dtype(names=None) -> tuple[list[Finding], dict]:
+    """Audit every (selected) registry entry; report keyed by op name."""
+    findings, report = [], {}
+    for name, c in sorted(REGISTRY.items()):
+        if names is not None and name not in names:
+            continue
+        f, rep = audit_contract(c)
+        findings.extend(f)
+        report[name] = rep
+    return findings, report

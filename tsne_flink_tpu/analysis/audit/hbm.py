@@ -1,0 +1,230 @@
+"""hbm-footprint — static per-stage peak-HBM estimation and the OOM gate.
+
+Walks prepare → optimize for a :class:`~.plan.PlanConfig` and accounts the
+LIVE SET of each stage: the persistent arrays (input, kNN graph, assembled
+P, optimizer state) plus the stage's dominant transient (sort scratch,
+gather operands, distance tiles), with the tile-level terms taken from the
+SAME cost model the tile planner budgets with
+(``ops/knn_tiles.refine_chunk_bytes`` / ``project_block_bytes`` /
+``pick_knn_tiles``).  The report is per-stage and per-term, so an
+over-budget verdict names the line that blew it.
+
+Calibration anchor — the recorded round-5 1M single-chip OOM (16.12 G
+attempted vs 15.75 G HBM, docs/TPU_STATUS.md): under the pre-fix plan
+(``knn_padding="materialized"`` + sorted [N, S] assembly at the measured
+hub width) this model predicts a >15.75 G peak — the band sweep's two
+dead full-input copies alone lift the kNN stage past 12 G, and the
+hub-widened [N, S] layout puts the affinity/optimize stages far beyond
+the chip — while the committed fix (index-space padding + blocks
+assembly) lands the same workload comfortably inside the budget.  Both
+plans are committed as ``tests/audit_fixtures/plan_1m_*.json`` and the
+regression is pinned by ``tests/test_audit.py``.
+
+Deliberately an ESTIMATE, not a simulation: XLA's buffer assignment can
+overlap or extend live ranges either way; the model counts what the
+algorithm must hold, which is the quantity a plan author controls.  All
+formulas assume the f32/int32 layouts the pipeline launches (bf16 matmul
+operands are trace-time casts of tile operands, already inside the tile
+terms' budget fraction).
+"""
+
+from __future__ import annotations
+
+import math
+
+from tsne_flink_tpu.analysis.core import Finding
+from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+
+RULE = "hbm-footprint"
+
+#: double-buffering factor for tile operands under lax.map pipelining —
+#: the same several-tiles-live-at-once reality TILE_BUDGET_FRACTION in
+#: ops/knn_tiles.py budgets for.
+PIPELINE_FACTOR = 2
+
+
+def _gib(b: float) -> float:
+    return round(b / (1 << 30), 3)
+
+
+def _knn_stage(plan: PlanConfig) -> dict:
+    """Live-set candidates of the kNN stage; the stage peak is their max."""
+    from tsne_flink_tpu.ops.knn_tiles import (pick_knn_tiles,
+                                              refine_chunk_bytes)
+    n, d, k, isz = plan.n, plan.d, plan.k, plan.itemsize
+    x = float(n * d * isz) if plan.knn_method != "precomputed" else 0.0
+    graph = float(n * k * (4 + isz))          # idx int32 + dist
+    terms: dict[str, float] = {"input": x, "graph": graph}
+    if plan.knn_method in ("bruteforce", "partition"):
+        tiles = pick_knn_tiles(n, d, k, plan.backend)
+        # one [row_chunk, n] distance tile (+ top-k scratch), pipelined
+        terms["exact_tile"] = PIPELINE_FACTOR * tiles.row_chunk * n * isz
+        terms["peak"] = x + graph + terms["exact_tile"]
+        return terms
+    if plan.knn_method == "precomputed":
+        terms["peak"] = graph
+        return terms
+
+    rounds, refine = plan.resolved_knn()
+    tiles = pick_knn_tiles(n, d, k, plan.backend)
+    b = min(tiles.block, n)
+    npad = math.ceil(n / b) * b
+
+    # --- band sweep (per Z-order round) ---
+    from tsne_flink_tpu.ops.knn_tiles import project_block_bytes
+    band_tile = PIPELINE_FACTOR * project_block_bytes(b, d, k, itemsize=isz)
+    zorder = n * (3 * isz + 2 * 4)            # projected coords, keys, perm
+    if plan.knn_padding == "materialized":
+        # pre-fix staging: permuted copy + padded copy of the full input
+        pad_extra = 2.0 * x
+    else:
+        pad_extra = (npad + 2 * k) * 4.0      # padded PERMUTATION only
+    # sorted-order results + scatter-back to original order
+    round_out = 2.0 * npad * k * (4 + isz)
+    # earlier rounds' candidate sets held for the cross-round merge
+    held = max(0, rounds - 1) * n * k * (4 + isz)
+    band = x + zorder + pad_extra + band_tile + round_out + held
+    terms["band_sweep"] = band
+
+    # --- cross-round merge: concat + 2-pass sort of the [n, rounds*k]
+    # candidate set (ids + dists, operands and scratch ~3 copies) ---
+    merge_w = max(rounds, 2) * k
+    merge = x + 3.0 * n * merge_w * (4 + isz)
+    terms["round_merge"] = merge
+
+    peak = max(band, merge)
+    if refine > 0:
+        # --- refine cycles: graph + reverse-sample edge sort + per-round
+        # projections + the funnel chunk (the planner's own byte model) ---
+        from tsne_flink_tpu.ops.knn import pick_knn_cascade, pick_knn_filter
+        fd = pick_knn_filter(d) or 0
+        cd = pick_knn_cascade(d) or 0
+        proj = n * (fd + cd) * isz
+        rev_sort = 3.0 * 2.0 * n * k * 4     # (dst, score, src) 2-pass sort
+        chunk = PIPELINE_FACTOR * refine_chunk_bytes(
+            tiles.refine_chunk, d, k, itemsize=isz)
+        refine_live = x + graph + proj + rev_sort + n * 16 * 4 + chunk
+        terms["refine"] = refine_live
+        # each cycle also merges 2 fresh Z-rounds into the graph
+        terms["cycle_merge"] = x + graph + 3.0 * n * 2 * k * (4 + isz)
+        peak = max(peak, refine_live, terms["cycle_merge"])
+    terms["peak"] = peak
+    return terms
+
+
+def _affinity_stage(plan: PlanConfig) -> dict:
+    """β search + symmetrized assembly; input stays live (tsne_embed holds
+    x through prepare)."""
+    n, k, isz = plan.n, plan.k, plan.itemsize
+    x = float(n * plan.d * isz) if plan.knn_method != "precomputed" else 0.0
+    graph = float(n * k * (4 + isz))
+    p_cond = float(n * k * isz)
+    s = plan.sym_width_est()
+    label = plan.resolved_assembly()
+    terms: dict[str, float] = {"input": x, "graph": graph, "p_cond": p_cond,
+                               "assembly": label}
+    if label == "sorted":
+        # 2Nk (i, j, v) triples through a 2-key sort (operands + scratch)
+        terms["edge_sort"] = 2.0 * 2.0 * n * k * (8 + isz)
+        terms["rows"] = float(n * s * (4 + isz))
+    else:
+        # split/split-rows/blocks share the reverse_merge + 1-key sort core
+        kk_chunk = min(n * k * k, 2 ** 27)   # reverse_merge row_chunk cap
+        terms["reverse_merge"] = 2.0 * kk_chunk * isz + n * k * isz
+        terms["edge_sort"] = 2.0 * n * k * (8 + isz)
+        if label == "blocks":
+            # forward [N, k] values + the (src, dst, val) reverse triple
+            terms["rows"] = n * k * isz + n * k * (8.0 + isz)
+        else:
+            terms["rows"] = float(n * s * (4 + isz))
+    terms["peak"] = (x + graph + p_cond + terms.get("reverse_merge", 0.0)
+                     + terms["edge_sort"] + terms["rows"])
+    return terms
+
+
+def _optimize_stage(plan: PlanConfig) -> dict:
+    """The compiled loop's resident set + its dominant per-iteration
+    transients."""
+    n, k, m, isz = plan.n, plan.k, plan.n_components, plan.itemsize
+    s = plan.sym_width_est()
+    label = plan.resolved_assembly()
+    rep = plan.resolved_repulsion()
+    terms: dict[str, float] = {"repulsion": rep, "assembly": label}
+    state = 2.0 * 3.0 * n * m * isz           # (y, update, gains), updated
+    y_full = float(n * m * isz)
+    terms["state"] = state + y_full
+    if label == "blocks":
+        p_arrays = n * k * (4.0 + isz) + n * k * (8.0 + isz)
+        e_attr = n * k                        # reverse block edge count
+        attr = e_attr * (2.0 * m * isz + 4.0 * isz)
+    else:
+        p_arrays = float(n * s * (4 + isz))
+        # layout decision mirrors plan_edges' gate with the ~2Nk true-edge
+        # upper bound: hub-widened rows route to the flat edge layout
+        e_est = 2.0 * n * k
+        from tsne_flink_tpu.ops.affinities import edges_beneficial
+        if plan.attraction == "edges" or (
+                plan.attraction == "auto" and edges_beneficial(e_est, n, s)):
+            attr = e_est * (3.0 * 4.0 + 2.0 * m * isz + 2.0 * isz)
+        else:
+            c = min(plan.row_chunk, n)
+            attr = PIPELINE_FACTOR * c * s * (m * isz + isz + 4.0)
+    terms["p_arrays"] = p_arrays
+    terms["attraction"] = attr
+    if rep == "exact":
+        c = min(plan.row_chunk, n)
+        terms["repulsion_tile"] = PIPELINE_FACTOR * c * n * isz
+    elif rep == "bh":
+        from tsne_flink_tpu.ops.repulsion_bh import (default_frontier,
+                                                     default_levels)
+        lv = default_levels(n, m)
+        fr = default_frontier(n, m, lv, plan.theta)
+        c = min(plan.row_chunk, n)
+        terms["repulsion_tile"] = c * fr * 3.0 * isz + n * lv * 4.0
+    else:  # fft
+        from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
+        g = DEFAULT_GRID.get(m, 1024)
+        terms["repulsion_tile"] = float((2 * g) ** m * (1 + m + 2) * 2 * isz)
+    terms["peak"] = (terms["state"] + p_arrays + attr
+                     + terms["repulsion_tile"])
+    return terms
+
+
+def plan_hbm_report(plan: PlanConfig) -> dict:
+    """Per-stage peak-HBM estimates + the plan-level verdict."""
+    stages = {"knn": _knn_stage(plan), "affinities": _affinity_stage(plan),
+              "optimize": _optimize_stage(plan)}
+    peak_stage = max(stages, key=lambda st: stages[st]["peak"])
+    peak = stages[peak_stage]["peak"]
+    budget = plan.hbm_budget()
+    report = {
+        "plan": plan.name,
+        "stages": {st: {t: (v if isinstance(v, str) else _gib(v))
+                        for t, v in terms.items()}
+                   for st, terms in stages.items()},
+        "peak_hbm_est": int(peak),
+        "peak_hbm_est_gib": _gib(peak),
+        "peak_stage": peak_stage,
+        "hbm_budget": budget,
+        "ok": budget is None or peak <= budget,
+    }
+    return report
+
+
+def audit_hbm(plans) -> tuple[list[Finding], dict]:
+    """Run the footprint model over ``plans``; over-budget plans become
+    findings (the OOM gate the CLI's ``--auditPlan`` enforces)."""
+    findings, reports = [], {}
+    for plan in plans:
+        rep = plan_hbm_report(plan)
+        reports[plan.name] = rep
+        if not rep["ok"]:
+            findings.append(Finding(
+                RULE, f"plan:{plan.name}", 1, 0,
+                f"predicted peak HBM {rep['peak_hbm_est_gib']} GiB in the "
+                f"'{rep['peak_stage']}' stage exceeds the "
+                f"{_gib(rep['hbm_budget'])} GiB {plan.backend} budget — "
+                "this plan is predicted to OOM (shrink the footprint: "
+                "assembly=blocks, a narrower sym_width, or shard the point "
+                "axis)"))
+    return findings, reports

@@ -1,0 +1,142 @@
+"""Pipeline plan descriptions for graftcheck — the audit tier's input.
+
+A :class:`PlanConfig` is everything the semantic auditors need to reason
+about one pipeline invocation WITHOUT running it: the workload shape
+``(n, d, k)``, the backend, the compute dtype, and the resolved stage
+choices (kNN method/rounds, assembly, repulsion, attraction).  It is the
+static twin of the argument set ``utils/artifacts.prepare`` +
+``models/tsne.optimize`` actually consume, and every resolver here calls
+the SAME policy functions the pipeline calls (``pick_knn_rounds`` /
+``pick_knn_refine`` / ``pick_repulsion`` / the ``affinity_auto`` byte
+gate), so the audited plan cannot drift from the launched one.
+
+Plans are JSON-serializable; the committed 1M OOM regression fixtures
+(``tests/audit_fixtures/plan_1m_*.json``) are PlanConfigs on disk.
+
+``knn_padding`` records how the project-kNN band sweep stages its sorted
+operands — the round-5 on-chip distinction:
+
+* ``"index-space"`` (current code): the PERMUTATION is padded and each
+  block gathers straight from ``x`` (``ops/knn.py:720-735``);
+* ``"materialized"`` (pre-fix): a permuted copy AND a padded copy of the
+  full input were materialized per round — the two dead ~3 GB buffers of
+  the recorded 1M single-chip OOM (16.12 G vs 15.75 G HBM,
+  docs/TPU_STATUS.md).
+
+``sym_width`` is the hub-widened symmetrized row width when known (it is
+data-dependent; the 60k bench records carry the measured 3608).  ``None``
+falls back to the lossless lower bound ``2k`` (lane-rounded) — fine for
+hub-free data, an underestimate on hub-heavy graphs, which is exactly why
+plans for workloads with measured widths should carry them.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field, replace
+
+#: usable HBM per accelerator backend for the OOM gate: a v5e-class chip
+#: carries 16 GiB of which ~15.75 G is allocatable (the recorded 1M OOM
+#: failed AT 16.12 G against this exact figure).  CPU hosts get no budget
+#: (None): the auditor still reports the estimate, but host RAM is not a
+#: launch-refusal criterion.
+HBM_BUDGET_BYTES = {"tpu": int(15.75 * (1 << 30))}
+
+KNN_PADDING_MODES = ("index-space", "materialized")
+
+
+@dataclass(frozen=True)
+class PlanConfig:
+    """One pipeline invocation, statically described."""
+
+    n: int
+    d: int
+    k: int = 90
+    backend: str = "tpu"
+    dtype: str = "float32"
+    n_components: int = 2
+    iterations: int = 300
+    knn_method: str = "project"
+    knn_rounds: int | None = None    # None = pick_knn_rounds(n)
+    knn_refine: int | None = None    # None = pick_knn_refine(n, d)
+    repulsion: str = "auto"          # None/auto = pick_repulsion(...)
+    theta: float = 0.25
+    theta_explicit: bool = False
+    assembly: str = "auto"
+    attraction: str = "auto"
+    sym_width: int | None = None     # measured hub width when known
+    row_chunk: int = 2048            # optimizer tile rows (TsneConfig)
+    knn_padding: str = "index-space"
+    name: str = "plan"
+
+    def __post_init__(self):
+        if self.knn_padding not in KNN_PADDING_MODES:
+            raise ValueError(f"knn_padding '{self.knn_padding}' not defined "
+                             f"({' | '.join(KNN_PADDING_MODES)})")
+        if self.assembly not in ("auto", "sorted", "split", "blocks"):
+            raise ValueError(f"assembly '{self.assembly}' not defined")
+
+    # ---- resolved plan quantities (the pipeline's own policies) ----
+
+    @property
+    def itemsize(self) -> int:
+        return {"float32": 4, "float64": 8, "bfloat16": 2}[self.dtype]
+
+    def resolved_knn(self) -> tuple[int, int]:
+        """(rounds, refine) exactly as utils/artifacts.resolve_knn_plan."""
+        from tsne_flink_tpu.utils.artifacts import resolve_knn_plan
+        rounds, refine = resolve_knn_plan(
+            self.n, self.d, self.knn_method, self.knn_rounds, self.knn_refine)
+        return (rounds or 0, refine or 0)
+
+    def resolved_repulsion(self) -> str:
+        """The backend the optimizer will actually dispatch."""
+        from tsne_flink_tpu.utils.cli import pick_repulsion
+        return pick_repulsion(self.repulsion or "auto", self.theta, self.n,
+                              self.n_components, self.theta_explicit,
+                              backend=self.backend)
+
+    def sym_width_est(self) -> int:
+        """Symmetrized row width: the measured width when the plan carries
+        one, else the hub-free lossless bound 2k (lane-rounded) — an
+        underestimate on hub-heavy graphs, documented in the module
+        docstring."""
+        if self.sym_width is not None:
+            return int(self.sym_width)
+        return max(8, (2 * self.k + 7) // 8 * 8)
+
+    def resolved_assembly(self) -> str:
+        """'auto' resolved through the SAME byte gate as
+        ``ops/affinities.affinity_auto``: rows (via the split builder) when
+        the estimated [N, S] layout fits ROWS_BYTES_MAX, else blocks."""
+        if self.assembly != "auto":
+            return self.assembly
+        from tsne_flink_tpu.ops.affinities import ROWS_BYTES_MAX
+        rows_bytes = self.n * self.sym_width_est() * (4 + self.itemsize)
+        return "split-rows" if rows_bytes <= ROWS_BYTES_MAX else "blocks"
+
+    def hbm_budget(self) -> int | None:
+        return HBM_BUDGET_BYTES.get(self.backend)
+
+    # ---- (de)serialization ----
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlanConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_json(cls, path: str) -> "PlanConfig":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+
+def bench_plan(n: int = 60_000, d: int = 784, k: int = 90,
+               backend: str = "tpu", **kw) -> PlanConfig:
+    """The headline bench workload (bench.py's shape) as a PlanConfig."""
+    return PlanConfig(n=n, d=d, k=k, backend=backend,
+                      name=kw.pop("name", f"bench-{n//1000}k-{backend}"),
+                      **kw)

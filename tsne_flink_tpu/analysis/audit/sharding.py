@@ -1,0 +1,182 @@
+"""sharding-contract — prove the SPMD programs' axis names against the mesh.
+
+The reference gets this for free: a Flink dataflow with a mis-wired
+shuffle does not type-check.  Our ``shard_map`` programs carry their
+parallelism in *strings* — an axis name in a ``psum``/``ppermute`` that
+does not match the mesh spec fails at trace time on the DEVICE MESH, i.e.
+historically at launch on scarce hardware.  This analyzer moves that to
+the audit tier:
+
+* **signature defaults** — every ``axis_name`` parameter in ``parallel/``
+  with a string default must name :data:`tsne_flink_tpu.parallel.mesh.AXIS`
+  (the one mesh axis ``make_mesh`` builds); a drifted default would bind
+  collectives to a dead axis the moment a caller relies on it.
+* **abstract traces** — the real sharded programs (``SpmdPipeline``'s
+  fused and prepare-only forms for both the ppermute-ring and the
+  Morton-band kNN, ``symmetrize_alltoall``, and the sharded ``optimize``
+  loop) are traced with ``jax.eval_shape`` over a mesh of whatever
+  devices the audit host has (a 1-wide CPU mesh suffices — axis-name
+  resolution is size-independent).  A trace error IS a finding; a
+  successful trace additionally yields the set of axis names every
+  collective in the jaxpr binds, which must be a subset of the mesh's.
+
+Abstract only: ``eval_shape``/``make_jaxpr`` on ShapeDtypeStructs — no
+data, no device computation.
+"""
+
+from __future__ import annotations
+
+from tsne_flink_tpu.analysis.core import Finding
+
+RULE = "sharding-contract"
+
+#: collective eqn params that carry axis names in a jaxpr
+_AXIS_PARAMS = ("axis_name", "axes", "axis_index_groups_axis")
+
+
+def collect_axis_names(jaxpr) -> set:
+    """Every axis name any collective in ``jaxpr`` (recursively) binds."""
+    from tsne_flink_tpu.analysis.audit.dtype import _iter_jaxprs
+    names: set = set()
+    for j in _iter_jaxprs(jaxpr):
+        for eqn in j.eqns:
+            for p in _AXIS_PARAMS:
+                v = eqn.params.get(p)
+                if v is None:
+                    continue
+                for item in (v if isinstance(v, (tuple, list)) else (v,)):
+                    if isinstance(item, str):
+                        names.add(item)
+    return names
+
+
+def _signature_findings() -> list[Finding]:
+    """axis_name defaults in parallel/ must equal mesh.AXIS."""
+    import inspect
+
+    from tsne_flink_tpu.parallel import knn as pknn
+    from tsne_flink_tpu.parallel import symmetrize as psym
+    from tsne_flink_tpu.parallel.mesh import AXIS
+
+    findings = []
+    for mod, relpath in ((pknn, "tsne_flink_tpu/parallel/knn.py"),
+                         (psym, "tsne_flink_tpu/parallel/symmetrize.py")):
+        for name, fn in vars(mod).items():
+            if not callable(fn) or getattr(fn, "__module__", "") \
+                    != mod.__name__:
+                continue
+            try:
+                sig = inspect.signature(fn)
+            except (TypeError, ValueError):
+                continue
+            p = sig.parameters.get("axis_name")
+            if p is None or not isinstance(p.default, str):
+                continue
+            if p.default != AXIS:
+                findings.append(Finding(
+                    RULE, relpath, 1, 0,
+                    f"{name}() defaults axis_name='{p.default}' but the "
+                    f"mesh axis is '{AXIS}' (parallel/mesh.py) — "
+                    "collectives would bind a dead axis"))
+    return findings
+
+
+def check_traced_axes(trace_fn, mesh, label: str) -> tuple[list, set]:
+    """Trace ``trace_fn()`` (which must return a jaxpr) and verify every
+    collective's axis name is live on ``mesh``.  Trace failures become
+    findings — that is the auditor catching at second 4 what the chip
+    would have thrown at launch."""
+    findings: list[Finding] = []
+    try:
+        jaxpr = trace_fn()
+    except Exception as e:  # noqa: BLE001 — any trace error is the finding
+        findings.append(Finding(
+            RULE, f"trace:{label}", 1, 0,
+            f"sharded program '{label}' fails to trace: "
+            f"{type(e).__name__}: {e}"))
+        return findings, set()
+    used = collect_axis_names(jaxpr)
+    dead = used - set(mesh.axis_names)
+    if dead:
+        findings.append(Finding(
+            RULE, f"trace:{label}", 1, 0,
+            f"sharded program '{label}' binds axis name(s) {sorted(dead)} "
+            f"that are not mesh axes {tuple(mesh.axis_names)}"))
+    return findings, used
+
+
+def audit_sharding() -> tuple[list[Finding], dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+    from tsne_flink_tpu.parallel.mesh import AXIS, make_mesh
+    from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+    from tsne_flink_tpu.utils.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    findings = _signature_findings()
+    report: dict = {"signature_defaults_ok": not findings}
+
+    mesh = make_mesh()
+    dcount = mesh.devices.size
+    n, d, k = 8 * dcount, 8, 4
+    key_data = jnp.asarray(jax.random.key_data(jax.random.key(0)))
+    x = jax.ShapeDtypeStruct((n, d), jnp.float32)
+    valid = jax.ShapeDtypeStruct((n,), jnp.bool_)
+    axes_used: set = set()
+
+    def pipeline_trace(knn_method):
+        cfg = TsneConfig(iterations=4, perplexity=1.5, repulsion="exact",
+                         row_chunk=8)
+        pipe = SpmdPipeline(cfg, n, d, k, knn_method=knn_method,
+                            knn_rounds=1, knn_refine=1)
+        fn = pipe._build_prepared()
+        return jax.make_jaxpr(lambda *a: fn(*a))(x, valid, key_data)
+
+    for method in ("bruteforce", "project"):
+        f, used = check_traced_axes(lambda m=method: pipeline_trace(m),
+                                    mesh, f"SpmdPipeline.prepare[{method}]")
+        findings.extend(f)
+        axes_used |= used
+
+    def optimize_trace():
+        cfg = TsneConfig(iterations=4, repulsion="exact", row_chunk=8)
+        state = TsneState(y=jax.ShapeDtypeStruct((n, 2), jnp.float32),
+                          update=jax.ShapeDtypeStruct((n, 2), jnp.float32),
+                          gains=jax.ShapeDtypeStruct((n, 2), jnp.float32))
+        pspec = P(AXIS)
+        sspec = TsneState(y=pspec, update=pspec, gains=pspec)
+        fn = shard_map(
+            lambda st, ji, jv: optimize(st, ji, jv, cfg, axis_name=AXIS),
+            mesh=mesh, in_specs=(sspec, pspec, pspec),
+            out_specs=(sspec, P()))
+        return jax.make_jaxpr(fn)(
+            state, jax.ShapeDtypeStruct((n, 2 * k), jnp.int32),
+            jax.ShapeDtypeStruct((n, 2 * k), jnp.float32))
+
+    f, used = check_traced_axes(optimize_trace, mesh, "optimize[shard_map]")
+    findings.extend(f)
+    axes_used |= used
+
+    def alltoall_trace():
+        from tsne_flink_tpu.parallel.symmetrize import symmetrize_alltoall
+        fn = shard_map(
+            lambda i, p: symmetrize_alltoall(i, p, dcount, 2 * k,
+                                             axis_name=AXIS),
+            mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS), P(), P(), P()))
+        return jax.make_jaxpr(fn)(
+            jax.ShapeDtypeStruct((n, k), jnp.int32),
+            jax.ShapeDtypeStruct((n, k), jnp.float32))
+
+    f, used = check_traced_axes(alltoall_trace, mesh,
+                                "symmetrize_alltoall[shard_map]")
+    findings.extend(f)
+    axes_used |= used
+
+    report["mesh_axes"] = list(mesh.axis_names)
+    report["devices"] = int(dcount)
+    report["axes_used"] = sorted(axes_used)
+    report["ok"] = not findings
+    return findings, report

@@ -20,6 +20,11 @@ once (ADVICE/VERDICT rounds 1-5); the linter catches it forever:
   declares (the ADVICE r5 #1 drift class, closed permanently).
 * ``cli-api-parity``   — argparse flags in ``build_parser`` against
   ``TSNE.__init__`` kwargs: missing counterparts and mismatched defaults.
+* ``audit-contract``   — every op in ``ops/`` and ``models/`` that is
+  jitted by name (``jax.jit(fn)`` / ``jax.jit(partial(fn, ...))`` /
+  ``@jax.jit``-decorated) declares a dtype contract in
+  ``analysis/audit/contracts.py``, so the graftcheck dtype-contract
+  auditor has full coverage of the jitted surface.
 
 Rules are pure-AST project passes registered with :func:`core.rule`; they
 never import the code under analysis.
@@ -567,6 +572,9 @@ CLI_ONLY_FLAGS = {
     "input", "output", "dimension", "inputDistanceMatrix", "executionPlan",
     "loss", "checkpoint", "checkpointEvery", "resume", "fatCheckpoint",
     "noCache", "profile", "coordinator", "numProcesses", "processId",
+    # launch-control gate, not a model hyper-parameter: the estimator runs
+    # in-process where the caller can invoke the audit API directly
+    "auditPlan",
 }
 
 #: estimator-only kwargs with no CLI counterpart (none at present; the
@@ -675,4 +683,98 @@ def cli_api_parity(project: Project):
             f"TSNE kwarg '{kwarg}' has no CLI flag counterpart: add the "
             "flag to utils/cli.py, or add it to API_ONLY_KWARGS with the "
             "rationale"))
+    return findings
+
+
+# ---- rule: audit-contract --------------------------------------------------
+
+CONTRACTS_SUFFIX = "analysis/audit/contracts.py"
+
+
+def _declared_contract_names(project: Project) -> set[str]:
+    """Bare function names declared via ``contract("...", ...)`` calls in
+    the graftcheck registry — parsed from the scanned copy, or (fixture
+    runs) from the file shipped next to this package.  Mirrors
+    :func:`_declared_env_vars`; the linter never imports the registry
+    (it builds JAX abstract values on import)."""
+    mod = project.module_with_suffix(CONTRACTS_SUFFIX)
+    tree = mod.tree if mod is not None else None
+    if tree is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "audit", "contracts.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except OSError:
+            return set()
+    declared = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "contract" and node.args):
+            name = _const_str(node.args[0])
+            if name:
+                declared.add(name.rsplit(".", 1)[-1].split("[")[0])
+    return declared
+
+
+def _is_jit_decorator(node) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` /
+    ``@functools.partial(jax.jit, ...)``."""
+    target = node
+    if isinstance(node, ast.Call) and (
+            (isinstance(node.func, ast.Name) and node.func.id == "partial")
+            or (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "partial")):
+        if not node.args:
+            return False
+        target = node.args[0]
+    return ((isinstance(target, ast.Attribute) and target.attr == "jit")
+            or (isinstance(target, ast.Name) and target.id == "jit"))
+
+
+@rule("audit-contract",
+      "ops/ and models/ functions jitted by name declare a dtype contract "
+      "in analysis/audit/contracts.py (graftcheck coverage)")
+def audit_contract(project: Project):
+    findings = []
+    declared = _declared_contract_names(project)
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        if not ("/ops/" in norm or norm.startswith("ops/")
+                or "/models/" in norm or norm.startswith("models/")):
+            continue
+        partial_names = _from_import_aliases(mod.tree, "partial")
+        # (a) @jax.jit-decorated defs
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                if node.name not in declared:
+                    findings.append(mod.finding(
+                        "audit-contract", node,
+                        f"@jax.jit-decorated op '{node.name}' has no dtype "
+                        "contract: add a contract(...) entry to "
+                        "tsne_flink_tpu/analysis/audit/contracts.py so the "
+                        "dtype-contract auditor covers it"))
+        # (b) call-site jits of module-level named functions
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "jit")
+                         or (isinstance(node.func, ast.Name)
+                             and node.func.id == "jit"))
+                    and node.args):
+                continue
+            target, _kw, _pos = _unwrap_partial(node.args[0], partial_names)
+            if not isinstance(target, ast.Name):
+                continue  # lambdas/closures: their callees carry contracts
+            if project.resolve_function(mod, target.id) is None:
+                continue  # nested helper closing over its config
+            if target.id not in declared:
+                findings.append(mod.finding(
+                    "audit-contract", node,
+                    f"'{target.id}' is jitted here but has no dtype "
+                    "contract: add a contract(...) entry to "
+                    "tsne_flink_tpu/analysis/audit/contracts.py so the "
+                    "dtype-contract auditor covers it"))
     return findings

@@ -463,7 +463,9 @@ def symmetrized_width(idx: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
         idx.reshape(-1), num_segments=n)
     # upper bound (mutual pairs counted twice is fine — only wastes padding)
     max_deg = jnp.max(out_deg + in_deg)
-    return jnp.maximum(8, (max_deg + 7) // 8 * 8)
+    # int32 like split_width (audit dtype-contract): the bool-sum out_deg
+    # is a platform int, which upcast the width to int64 under x64
+    return jnp.maximum(8, (max_deg + 7) // 8 * 8).astype(jnp.int32)
 
 
 def assemble_rows(ii: jnp.ndarray, jj: jnp.ndarray, vv: jnp.ndarray,

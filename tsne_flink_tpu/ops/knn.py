@@ -531,19 +531,33 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
         if do_filter:
             # fresh projection per round: filter errors decorrelate across
             # rounds, so a candidate unluckily filtered out this round gets
-            # re-proposed and re-judged under a different projection later
+            # re-proposed and re-judged under a different projection later.
+            # Projection matmuls follow the mixed-precision operand setting
+            # like every other full-width feature matmul (audit
+            # dtype-contract: a JL rank estimate already carries
+            # ~sqrt(2/width) noise, bf16 operands are far inside it)
+            from tsne_flink_tpu.ops.metrics import acc_dtype, matmul_operands
             r = jax.random.normal(fkey, (dim, filter_dims), xf.dtype
                                   ) / jnp.sqrt(jnp.asarray(dim, xf.dtype))
-            proj = fbase @ r                               # [N, fd]
+            fm, rm = matmul_operands(fbase, r)
+            proj = jnp.matmul(fm, rm,
+                              preferred_element_type=acc_dtype(fbase))
             psq = jnp.sum(proj * proj, axis=1)
         if do_cascade:
+            from tsne_flink_tpu.ops.metrics import acc_dtype, matmul_operands
             r2 = jax.random.normal(ckey, (dim, cascade_dims), xf.dtype
                                    ) / jnp.sqrt(jnp.asarray(dim, xf.dtype))
-            proj2 = fbase @ r2                             # [N, cd]
+            fm2, rm2 = matmul_operands(fbase, r2)
+            proj2 = jnp.matmul(fm2, rm2,
+                               preferred_element_type=acc_dtype(fbase))
             p2sq = jnp.sum(proj2 * proj2, axis=1)
         gidx_loc = gidx[rows_g]                       # [nloc, k]
         if s < k:
-            score = jax.random.uniform(gkey, gidx_loc.shape)
+            # score dtype threaded (audit dtype-contract): the default float
+            # dtype is f64 under the x64 test config, silently drawing a
+            # double-width RNG tensor per round for a rank-only comparison
+            score = jax.random.uniform(gkey, gidx_loc.shape,
+                                       dtype=xf.dtype)
             score = score.at[:, : max(1, s // 2)].set(-jnp.inf)
             # bottom-s by score via top_k of the negation (ties broken by
             # lowest index, same as a stable argsort): selection and order
@@ -700,10 +714,15 @@ def knn_project(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
 
     def round_coords(it: int, key):
         if dim > m:
+            # the Gaussian projection is a full-width feature matmul — it
+            # follows the mixed-precision operand setting like the distance
+            # tiles (audit dtype-contract); the banded re-rank stays exact
+            from tsne_flink_tpu.ops.metrics import acc_dtype, matmul_operands
             pkey, skey = jax.random.split(key)
             r = jax.random.normal(pkey, (dim, m), x.dtype) / jnp.sqrt(
                 jnp.asarray(dim, x.dtype))
-            z = zbase @ r
+            zb, rm = matmul_operands(zbase, r)
+            z = jnp.matmul(zb, rm, preferred_element_type=acc_dtype(zbase))
         else:
             z = zbase
             skey = key

@@ -78,4 +78,7 @@ def zorder_permutation(coords: jnp.ndarray) -> jnp.ndarray:
     """
     m = coords.shape[1]
     keys = morton_keys(quantize(coords, BITS_FOR_DIMS[m]))
-    return jnp.argsort(keys)
+    # int32 result is part of the module's int32-safety contract (audit
+    # dtype-contract): argsort returns platform ints, i.e. int64 under the
+    # x64 test config, and every consumer gathers with these
+    return jnp.argsort(keys).astype(jnp.int32)

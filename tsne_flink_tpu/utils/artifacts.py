@@ -48,7 +48,11 @@ MAGIC = "tsne_flink_tpu-artifact-v1"
 #: alter the arrays without changing any fingerprint input).
 #: 2: round-6 refine funnel rework (in-row candidate dedup, JL-stage skip,
 #: pre-top-k merge) — same recall contract, different bits.
-FORMAT_VERSION = 2
+#: 3: round-7 dtype-contract fixes (graftcheck): the refine gateway score
+#: draws in the compute dtype (was f64 under x64) and the JL/Z-order
+#: projection matmuls follow the mixed-precision operand setting (bf16 on
+#: TPU) — same recall contract, different bits under those configs.
+FORMAT_VERSION = 3
 
 KIND_KNN = "knn"
 KIND_AFFINITY = "affinity"

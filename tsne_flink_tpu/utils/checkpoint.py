@@ -33,10 +33,14 @@ MAGIC = "tsne_flink_tpu-ckpt-v2"
 _MAGICS = (MAGIC_V1, MAGIC)
 
 #: array names a prepare payload may carry (stored with a ``prep_`` prefix
-#: so they can never collide with working-set keys).  ``affinity_fp`` and
-#: ``label`` are strings; the rest are the artifact arrays themselves
-#: (``jidx``/``jval`` plus the blocks triple when label == "blocks").
-PREPARE_KEYS = ("affinity_fp", "label", "jidx", "jval",
+#: so they can never collide with working-set keys).  ``affinity_fp``,
+#: ``label`` and ``audit`` are strings (``audit`` is the JSON-encoded
+#: graftcheck plan summary — --auditPlan's {peak_hbm_est, hbm_budget,
+#: compile_count} — so a resume can detect a config whose predicted
+#: footprint drifted from the run that wrote the file); the rest are the
+#: artifact arrays themselves (``jidx``/``jval`` plus the blocks triple
+#: when label == "blocks").
+PREPARE_KEYS = ("affinity_fp", "label", "audit", "jidx", "jval",
                 "rsrc", "rdst", "rval")
 
 

@@ -163,6 +163,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the prepare-artifact cache (always "
                         "recompute kNN + affinities); $TSNE_ARTIFACTS=0 "
                         "sets the same default")
+    p.add_argument("--auditPlan", nargs="?", const="fail", default=None,
+                   choices=["fail", "warn"],
+                   help="run the graftcheck plan audit (static per-stage "
+                        "peak-HBM estimate + compile count, "
+                        "tsne_flink_tpu/analysis/audit/) before launching "
+                        "and REFUSE a run predicted to OOM the device "
+                        "budget; --auditPlan=warn prints the same report "
+                        "but launches anyway.  The result is embedded in "
+                        "v2 checkpoints so a resume can detect a config "
+                        "whose predicted footprint drifted")
     p.add_argument("--profile", default=None,
                    help="jax.profiler trace directory")
     # multi-host bring-up (jax.distributed over DCN — the analog of the
@@ -247,6 +257,99 @@ def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2,
     if theta_explicit or n_components == 3:
         return "bh"
     return "fft"
+
+
+def _run_plan(args, cfg, n: int, assembly: str, neighbors: int):
+    """This invocation as a graftcheck PlanConfig (the static twin of what
+    the stages below will launch — same resolved repulsion/assembly)."""
+    import jax
+
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    return PlanConfig(
+        n=n, d=int(args.dimension), k=int(neighbors),
+        backend=jax.default_backend(),
+        dtype="float32" if args.dtype == "bfloat16" else args.dtype,
+        n_components=cfg.n_components, iterations=cfg.iterations,
+        knn_method=("precomputed" if args.inputDistanceMatrix
+                    else args.knnMethod),
+        knn_rounds=args.knnIterations, knn_refine=args.knnRefine,
+        repulsion=cfg.repulsion, theta=cfg.theta,
+        assembly=assembly, attraction=cfg.attraction,
+        sym_width=args.symWidth, row_chunk=cfg.row_chunk,
+        name="cli-launch")
+
+
+def _plan_audit_summary(plan, checkpoint_every: int = 0) -> dict:
+    """The compact audit record checkpoints/benches carry."""
+    from tsne_flink_tpu.analysis.audit.compile import plan_compile_count
+    from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
+    rep = plan_hbm_report(plan)
+    return {"peak_hbm_est": rep["peak_hbm_est"],
+            "peak_stage": rep["peak_stage"],
+            "hbm_budget": rep["hbm_budget"], "ok": rep["ok"],
+            "compile_count": plan_compile_count(plan, checkpoint_every)}
+
+
+def _audit_gate(args, cfg, n: int, assembly: str, neighbors: int):
+    """--auditPlan: print the static plan audit and refuse a predicted OOM
+    (the 'linter told us at second 4' gate; --auditPlan=warn overrides).
+    Returns the summary dict for the checkpoint payload."""
+    from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
+    plan = _run_plan(args, cfg, n, assembly, neighbors)
+    rep = plan_hbm_report(plan)
+    summary = _plan_audit_summary(plan, args.checkpointEvery)
+    gib = 1 << 30
+    print(f"# auditPlan: peak HBM est {rep['peak_hbm_est_gib']} GiB in "
+          f"'{rep['peak_stage']}' "
+          + ("(no device budget on this backend)" if rep["hbm_budget"]
+             is None else f"vs {rep['hbm_budget'] / gib:.2f} GiB budget")
+          + f"; ~{summary['compile_count']} compiled programs")
+    for stage, terms in rep["stages"].items():
+        print(f"# auditPlan:   {stage}: "
+              + " ".join(f"{t}={v}" for t, v in terms.items()))
+    if not rep["ok"]:
+        msg = (f"plan predicted to OOM: peak HBM estimate "
+               f"{rep['peak_hbm_est_gib']} GiB in the '{rep['peak_stage']}' "
+               f"stage exceeds the {rep['hbm_budget'] / gib:.2f} GiB "
+               "device budget")
+        if args.auditPlan == "warn":
+            print(f"WARNING: {msg} — launching anyway (--auditPlan=warn)",
+                  file=sys.stderr)
+        else:
+            raise SystemExit(
+                f"{msg}; shrink the footprint (--affinityAssembly blocks, "
+                "a narrower --symWidth, --spmd sharding) or override with "
+                "--auditPlan=warn")
+    return summary
+
+
+def _check_resumed_audit(args, cfg, n, assembly, neighbors, prep_payload):
+    """A v2 checkpoint carries the original run's plan audit: recompute the
+    prediction for THIS run's config and surface a drifted footprint (the
+    resume may be on a different backend / assembly / width than the run
+    that wrote the checkpoint)."""
+    raw = (prep_payload or {}).get("audit")
+    if not raw:
+        return
+    try:
+        prev = json.loads(str(raw))
+    except ValueError:
+        return
+    cur = _plan_audit_summary(_run_plan(args, cfg, n, assembly, neighbors),
+                              args.checkpointEvery)
+    old_peak = float(prev.get("peak_hbm_est") or 0)
+    new_peak = float(cur["peak_hbm_est"])
+    ratio = new_peak / old_peak if old_peak > 0 else float("inf")
+    if prev.get("ok") is not False and cur["ok"] is False:
+        print("WARNING: resumed config's predicted footprint "
+              f"({new_peak / 2**30:.3g} GiB) now exceeds the device budget "
+              "although the original run's did not — the resume is not the "
+              "run that was checkpointed", file=sys.stderr)
+    elif ratio > 1.5 or ratio < 1 / 1.5:
+        print(f"WARNING: resumed config's predicted peak HBM "
+              f"({new_peak / 2**30:.3g} GiB) differs {ratio:.2f}x from the "
+              f"checkpointed run's ({old_peak / 2**30:.3g} GiB) — config "
+              "drift between save and resume", file=sys.stderr)
 
 
 def _load_resume(args, dtype):
@@ -478,6 +581,12 @@ def _main(argv=None) -> int:
         bh_gate=args.bhGate,
     )
 
+    # static plan audit BEFORE any expensive stage: the whole point is
+    # refusing a predicted OOM in seconds instead of at hour 4 on-chip
+    audit_summary = None
+    if args.auditPlan:
+        audit_summary = _audit_gate(args, cfg, n, assembly, neighbors)
+
     if args.spmd:
         # the whole job as ONE sharded program (SpmdPipeline); with
         # --checkpoint/--resume it switches to the segmented prepare+optimize
@@ -552,6 +661,12 @@ def _main(argv=None) -> int:
     # bench.py / tsne_embed via utils/artifacts.prepare and artifact-cached;
     # a v2 fat checkpoint skips it entirely
     start_iter, loss_carry, state, prep_payload = _load_resume(args, dtype)
+    if args.resume:
+        # v2 checkpoints carry the original run's plan audit: detect a
+        # resume whose config predicts a different footprint than the run
+        # that wrote the checkpoint (backend/assembly/width drift)
+        _check_resumed_audit(args, cfg, n, assembly, neighbors,
+                             prep_payload)
 
     prep_kwargs = dict(
         neighbors=neighbors, knn_method=args.knnMethod, metric=args.metric,
@@ -601,6 +716,8 @@ def _main(argv=None) -> int:
     # v2 checkpoints carry the prepare provenance; --fatCheckpoint embeds
     # the arrays themselves so a resume needs neither cache nor recompute
     save_payload = {"label": label}
+    if audit_summary is not None:
+        save_payload["audit"] = json.dumps(audit_summary)
     if affinity_fp is None and (args.checkpoint and args.fatCheckpoint):
         _, affinity_fp = art.prepare_fingerprints(**prep_kwargs)
     if affinity_fp is not None:
