@@ -78,7 +78,7 @@ RECORD_BASE_KEYS = (
     "metric", "unit", "backend", "devices", "n", "iterations", "repulsion",
     "theta", "knn_rounds", "knn_refine", "data", "data_seed", "peak_flops",
     "peak_flops_basis", "assembly", "cache", "matmul_dtype", "knn_tiles",
-    "audit",
+    "audit", "degradations",
 )
 
 
@@ -114,9 +114,15 @@ def _emit(rec: dict) -> None:
     line = json.dumps(rec)
     print(line, flush=True)
     try:
-        os.makedirs("results", exist_ok=True)
-        with open("results/bench_progress.json", "w") as f:
-            f.write(line + "\n")
+        # atomic tmp+rename (utils/io.atomic_write): a kill mid-write must
+        # never leave truncated JSON for downstream harvesting
+        from tsne_flink_tpu.utils.io import atomic_write
+
+        def emit(tmp):
+            with open(tmp, "w") as f:
+                f.write(line + "\n")
+
+        atomic_write("results/bench_progress.json", emit)
     except OSError:
         pass
 
@@ -362,6 +368,20 @@ def main():
                  "hbm_budget": _hbm["hbm_budget"], "ok": _hbm["ok"],
                  "compile_count": plan_compile_count(_plan, seg)}
 
+    # run supervisor (tsne_flink_tpu/runtime/): the OOM degradation ladder
+    # + divergence sentinel around prepare and the segmented optimize;
+    # its ladder steps ride EVERY record ("degradations") so a degraded
+    # run can never present itself as the requested plan, replacing the
+    # old ad-hoc per-round retry notes with structured events
+    from tsne_flink_tpu.runtime.supervisor import Supervisor
+    sup = Supervisor(_plan, max_retries=env_int("TSNE_MAX_RETRIES"),
+                     on_oom=env_str("TSNE_ON_OOM"),
+                     health_check=env_bool("TSNE_HEALTH_CHECK"))
+    if env_bool("TSNE_TUNNEL_DOWN"):
+        sup.events.append({"type": "tunnel-fallback", "stage": "startup",
+                           "detail": "accelerator tunnel unavailable; "
+                                     "CPU-pinned child (retry wrapper)"})
+
     base = {
         "metric": "mnist60k_embed_seconds", "unit": "s",
         "backend": backend, "devices": jax.device_count(),
@@ -382,6 +402,10 @@ def main():
         "knn_tiles": tile_plan.as_record(),
         # graftcheck plan audit: static peak-HBM + compile-count prediction
         "audit": audit_rec,
+        # supervisor ladder steps (runtime/ladder.py) — overwritten with
+        # the live list at every emission, so a mid-run demotion is
+        # visible from the first record that follows it
+        "degradations": [],
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
@@ -396,6 +420,7 @@ def main():
                "vs_baseline": round(10.0 / est, 3), "partial": True,
                "measured_seconds": round(float(measured_s), 3),
                "stages": {k_: round(v, 3) for k_, v in stages.items()},
+               "degradations": sup.degradations,
                "estimate_basis": note})
 
     x = jnp.asarray(x_np)
@@ -426,12 +451,19 @@ def main():
                          "basis for the remainder yet")
 
     from tsne_flink_tpu.utils.artifacts import prepare as prepare_stage
-    prep = prepare_stage(x, neighbors=k, knn_method="project",
-                         knn_rounds=rounds, knn_refine=refine,
-                         key=jax.random.key(0), perplexity=cfg.perplexity,
-                         assembly=assembly, cache=art_cache,
-                         on_stage=on_stage,
-                         knn_autotune=env_bool("TSNE_KNN_AUTOTUNE"))
+    # prepare runs under the supervisor: an OOM (real or injected via
+    # TSNE_FAULT_PLAN) degrades the plan through the ladder and relaunches
+    # only the failed stage; the record's resolved assembly/knn_tiles and
+    # "degradations" then report what actually ran
+    prep = sup.run_prepare(
+        lambda on_stage, **ov: prepare_stage(
+            x, neighbors=k, knn_method="project",
+            knn_rounds=rounds, knn_refine=refine,
+            key=jax.random.key(0), perplexity=cfg.perplexity,
+            cache=art_cache, on_stage=on_stage,
+            knn_autotune=env_bool("TSNE_KNN_AUTOTUNE"),
+            **{"assembly": assembly, **ov}),
+        on_stage=on_stage)
     t_knn, t_aff = prep.knn_seconds, prep.affinity_seconds
     jidx, jval, extra = prep.jidx, prep.jval, prep.extra_edges
     label = prep.label
@@ -503,8 +535,13 @@ def main():
             raise _DeadlineStop
 
     try:
-        state, losses = runner(state, jidx, jval, checkpoint_every=seg,
-                               checkpoint_cb=cb, extra_edges=extra)
+        # supervised optimize: OOM demotes repulsion via the ladder and
+        # relaunches from the last segment boundary; _DeadlineStop (not an
+        # OOM) passes straight through to the window-proofing handler
+        state, losses = sup.run_optimize(
+            lambda c: runner if c is cfg else ShardedOptimizer(c, n),
+            cfg, state, jidx, jval, checkpoint_every=seg,
+            checkpoint_cb=cb, extra_edges=extra)
         it_done = iters
     except _DeadlineStop:
         state, losses = prog["state"], prog["losses"]
@@ -562,7 +599,10 @@ def main():
            "cache_stages": {"knn": prep.knn_cache,
                             "affinities": prep.affinity_cache},
            "final_kl": round(final_kl, 4) if final_kl is not None else None,
-           "sym_width": s, "attraction": layout, "attraction_pairs": pairs}
+           "sym_width": s, "attraction": layout, "attraction_pairs": pairs,
+           # supervisor history: ladder steps + every recovery decision
+           # (oom / degrade / relaunch / sentinel-rollback events)
+           "degradations": sup.degradations, "runtime_events": sup.events}
     if not complete:
         rec.update(extrapolated=True, iterations_run=it_done,
                    measured_seconds=round(measured_s, 3))

@@ -23,7 +23,7 @@ _FAST_MODULES = {
     "test_optimizer",
     "test_flops", "test_edge_cases", "test_native_io", "test_pallas",
     "test_checkpoint", "test_cli", "test_quality_gate", "test_cache",
-    "test_artifacts", "test_knn_tiles", "test_audit",
+    "test_artifacts", "test_knn_tiles", "test_audit", "test_runtime",
 }
 
 

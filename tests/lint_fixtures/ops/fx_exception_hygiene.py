@@ -1,0 +1,69 @@
+"""exception-hygiene fixture: broad handlers that swallow are findings;
+re-raising, logging, narrow, and rationale'd-suppressed twins stay silent.
+The marked lines are the seeded findings
+(tests/test_lint.py::test_rule_fires_exactly_at_seeded_violations)."""
+
+import sys
+import warnings
+
+
+def risky():
+    raise RuntimeError("boom")
+
+
+def swallow_exception():
+    try:
+        risky()
+    except Exception:  # VIOLATION
+        pass
+
+
+def swallow_bare():
+    try:
+        risky()
+    except:  # noqa: E722  # VIOLATION
+        return None
+
+
+def swallow_tuple():
+    try:
+        risky()
+    except (ValueError, Exception):  # VIOLATION
+        return None
+
+
+def reraises():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def logs_print():
+    try:
+        risky()
+    except Exception as e:
+        print(f"risky failed: {e}", file=sys.stderr)
+
+
+def logs_warn():
+    try:
+        risky()
+    except Exception as e:
+        warnings.warn(str(e))
+
+
+def narrow_is_fine():
+    try:
+        risky()
+    except RuntimeError:
+        pass
+
+
+def suppressed_with_rationale():
+    try:
+        risky()
+    # graftlint: disable=exception-hygiene -- best-effort cleanup: a failed
+    # temp-file removal must never mask the original error path
+    except Exception:
+        pass

@@ -168,6 +168,52 @@ def test_segmented_run_bit_identical(tmp_path):
                                   np.asarray(full_losses))
 
 
+def test_kill_at_every_segment_boundary_resume_matrix(tmp_path):
+    """Crash-at-EVERY-boundary matrix (ISSUE 5 satellite): for each
+    optimize segment boundary, simulate a kill right after its checkpoint
+    file landed — resume from the FILE (full save/load round trip, not an
+    in-memory state) and require the final embedding bit-identical to the
+    uninterrupted run.  The subprocess SIGKILL twin of this contract
+    (real kill@optimize:seg1 via the fault injector) lives in
+    tests/test_runtime.py."""
+    st, jidx, jval = problem()
+    cfg = TsneConfig(iterations=40, repulsion="exact", row_chunk=16)
+    full_state, full_losses = ShardedOptimizer(cfg, 40, n_devices=1)(
+        st, jidx, jval)
+
+    # one segmented run writes a rotating checkpoint at every boundary;
+    # keep a copy per boundary to emulate "the file the kill left behind"
+    boundary_files = {}
+
+    def save_cb(s, it, losses):
+        path = os.path.join(str(tmp_path), f"b{it}.npz")
+        ckpt.save(path, s, it, np.asarray(losses))
+        boundary_files[it] = path
+
+    seg_state, seg_losses = ShardedOptimizer(cfg, 40, n_devices=1)(
+        st, jidx, jval, checkpoint_every=10, checkpoint_cb=save_cb)
+    assert sorted(boundary_files) == [10, 20, 30]
+    np.testing.assert_array_equal(np.asarray(seg_state.y),
+                                  np.asarray(full_state.y))
+
+    for it, path in sorted(boundary_files.items()):
+        st_np, next_iter, loss_carry = ckpt.load(path)
+        assert next_iter == it
+        resumed = TsneState(y=jnp.asarray(st_np.y),
+                            update=jnp.asarray(st_np.update),
+                            gains=jnp.asarray(st_np.gains))
+        res_state, res_losses = ShardedOptimizer(cfg, 40, n_devices=1)(
+            resumed, jidx, jval, start_iter=next_iter,
+            loss_carry=loss_carry, checkpoint_every=10,
+            checkpoint_cb=lambda *a: None)
+        np.testing.assert_array_equal(np.asarray(res_state.y),
+                                      np.asarray(full_state.y),
+                                      err_msg=f"resume from boundary {it}")
+        np.testing.assert_array_equal(np.asarray(res_losses),
+                                      np.asarray(full_losses),
+                                      err_msg=f"resume from boundary {it}")
+
+
 def test_segmented_sharded_run_matches(tmp_path):
     st, jidx, jval = problem(n=43)
     cfg = TsneConfig(iterations=24, repulsion="exact", row_chunk=8)

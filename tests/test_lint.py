@@ -59,7 +59,8 @@ def test_repo_is_lint_clean():
 def test_all_rules_registered():
     assert set(RULES) == {"env-registry", "jit-hygiene", "host-sync",
                           "dtype-drift", "bench-record-contract",
-                          "cli-api-parity", "audit-contract"}
+                          "cli-api-parity", "audit-contract",
+                          "exception-hygiene"}
 
 
 # ---- every fixture violation is found, suppressions silence ---------------
@@ -72,6 +73,7 @@ FIXTURE_FOR_RULE = {
     "bench-record-contract": "fx_bench_contract.py",
     "cli-api-parity": "fx_cli_parity.py",
     "audit-contract": os.path.join("ops", "fx_audit_contract.py"),
+    "exception-hygiene": os.path.join("ops", "fx_exception_hygiene.py"),
 }
 
 

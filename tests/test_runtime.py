@@ -1,0 +1,497 @@
+"""Runtime resilience layer (ISSUE 5): fault injection, OOM ladder,
+divergence sentinel, verified checkpoint rollback.
+
+The acceptance contracts, all CPU-only:
+
+* ``TSNE_FAULT_PLAN=oom@knn:1`` completes via the ladder, with the
+  demotion recorded in the bench record's ``degradations``;
+* ``kill@optimize:seg1`` + resume reproduces the uninterrupted embedding
+  bit for bit (real SIGKILL, CLI subprocess);
+* a seeded-NaN segment rolls back and converges through the sentinel's
+  eta-halving retry;
+* same fault plan + seed -> same degradation sequence (ladder
+  determinism), in-process AND across bench subprocess records.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.runtime.health import DivergenceError
+from tsne_flink_tpu.runtime.ladder import OomLadder
+from tsne_flink_tpu.runtime.supervisor import (Supervisor, is_oom,
+                                               run_plan_from_fit,
+                                               supervised_embed)
+
+pytestmark = pytest.mark.fast
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_faults():
+    """Every test starts (and ends) with no fault plan installed."""
+    faults.activate(None)
+    yield
+    faults.activate(None)
+
+
+def problem(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, 6)) * 4.0
+    return jnp.asarray(centers[rng.integers(0, 3, n)]
+                       + rng.normal(size=(n, 6)))
+
+
+def small_cfg(iters=40):
+    from tsne_flink_tpu.models.tsne import TsneConfig
+    return TsneConfig(iterations=iters, perplexity=5.0, repulsion="exact",
+                      row_chunk=16)
+
+
+# ---- fault-plan grammar ----------------------------------------------------
+
+def test_fault_plan_grammar():
+    fs = faults.parse_plan("oom@knn:1, kill@optimize:seg2,"
+                           "corrupt@checkpoint,nan@optimize:seg1")
+    assert [(f.kind, f.site, f.trigger) for f in fs] == [
+        ("oom", "knn", "1"), ("kill", "optimize", "seg2"),
+        ("corrupt", "checkpoint", "1"), ("nan", "optimize", "seg1")]
+
+
+@pytest.mark.parametrize("bad", ["boom@knn", "oom@nowhere", "oom-knn",
+                                 "oom@knn:segx", "oom@knn:x"])
+def test_fault_plan_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+def test_injector_occurrence_counting_and_single_fire():
+    inj = faults.FaultInjector(faults.parse_plan("oom@knn:2"))
+    inj.fire("knn")  # first entry: no fault
+    with pytest.raises(faults.InjectedOom) as e:
+        inj.fire("knn")
+    assert "RESOURCE_EXHAUSTED" in str(e.value) and is_oom(e.value)
+    inj.fire("knn")  # fired once, never again
+    assert inj.log == [("oom", "knn", "2")]
+
+
+def test_injector_segment_trigger_points():
+    inj = faults.FaultInjector(faults.parse_plan("nan@optimize:seg2"))
+    assert inj.fire("optimize", seg=1, point="start") is None
+    f = inj.fire("optimize", seg=2, point="start")
+    assert f is not None and f.kind == "nan"
+    # kill faults only fire at the boundary point (not at segment start)
+    inj = faults.FaultInjector(faults.parse_plan("kill@optimize:seg1"))
+    assert inj.fire("optimize", seg=1, point="start") is None
+
+
+# ---- degradation ladder ----------------------------------------------------
+
+def test_ladder_order_and_exhaustion():
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    lad = OomLadder(PlanConfig(n=2000, d=64, k=30, backend="cpu", name="t"))
+    acts = []
+    while True:
+        d = lad.demote("knn")
+        if d is None:
+            break
+        acts.append(d.action)
+    assert acts == ["shrink-knn-tiles", "shrink-knn-tiles",
+                    "assembly-blocks"]
+    # optimize rung: repulsion demotes exact -> bh -> fft, then exhausts
+    assert lad.demote("optimize").after == "bh"
+    assert lad.demote("optimize").after == "fft"
+    assert lad.demote("optimize") is None
+    assert set(lad.overrides()) == {"knn_tiles", "assembly"}
+
+
+def test_ladder_consults_hbm_model():
+    """An assembly demotion records the audit model's predicted peaks —
+    and the blocks plan must predict no more HBM than the rows plan."""
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    lad = OomLadder(PlanConfig(n=100_000, d=784, k=90, backend="tpu",
+                               sym_width=3608, name="t"))
+    d = lad.demote("affinities")
+    assert d.action == "assembly-blocks"
+    assert d.peak_hbm_before is not None and d.peak_hbm_after is not None
+    assert d.peak_hbm_after <= d.peak_hbm_before
+
+
+# ---- supervisor: oom@knn ladder completion + determinism -------------------
+
+def run_supervised(x, cfg, cache, plan_spec):
+    faults.activate(plan_spec)
+    sup = Supervisor(run_plan_from_fit(x.shape[0], x.shape[1], 15, cfg,
+                                       "auto", "bruteforce"),
+                     max_retries=2, on_oom="ladder")
+    y, losses = supervised_embed(x, cfg, supervisor=sup, neighbors=15,
+                                 seed=0, artifact_cache=cache)
+    faults.activate(None)
+    return np.asarray(y), np.asarray(losses), sup
+
+
+def test_oom_at_knn_completes_via_ladder(tmp_path):
+    from tsne_flink_tpu.utils.artifacts import ArtifactCache
+    x, cfg = problem(), small_cfg()
+    y, losses, sup = run_supervised(x, cfg, ArtifactCache(str(tmp_path)),
+                                    "oom@knn:1")
+    assert np.isfinite(y).all() and np.isfinite(losses).all()
+    assert [d["action"] for d in sup.degradations] == ["shrink-knn-tiles"]
+    assert [e["type"] for e in sup.events] == ["oom", "degrade"]
+
+
+def test_ladder_determinism_same_plan_same_sequence(tmp_path):
+    """Satellite: same fault plan + seed -> same degradation sequence AND
+    the same embedding, bit for bit."""
+    from tsne_flink_tpu.utils.artifacts import ArtifactCache
+    x, cfg = problem(), small_cfg()
+    spec = "oom@knn:1,oom@affinities:1"
+    y1, l1, s1 = run_supervised(x, cfg, ArtifactCache(str(tmp_path / "a")),
+                                spec)
+    y2, l2, s2 = run_supervised(x, cfg, ArtifactCache(str(tmp_path / "b")),
+                                spec)
+    assert s1.degradations == s2.degradations
+    assert [d["action"] for d in s1.degradations] == [
+        "shrink-knn-tiles", "assembly-blocks"]
+    np.testing.assert_array_equal(y1, y2)
+    np.testing.assert_array_equal(l1, l2)
+
+
+def test_oom_relaunch_skips_completed_stage(tmp_path):
+    """'Relaunch the failed stage only': an affinity-stage OOM must NOT
+    recompute the kNN graph — the artifact cache serves it warm."""
+    from tsne_flink_tpu.utils.artifacts import ArtifactCache
+    x, cfg = problem(), small_cfg()
+    cache = ArtifactCache(str(tmp_path))
+    faults.activate("oom@affinities:1")
+    sup = Supervisor(run_plan_from_fit(x.shape[0], x.shape[1], 15, cfg,
+                                       "auto", "bruteforce"), max_retries=2)
+    stages = []
+    from tsne_flink_tpu.utils.artifacts import prepare as prepare_stage
+    prep = sup.run_prepare(
+        lambda on_stage, assembly="auto", knn_tiles=None: prepare_stage(
+            x, neighbors=15, knn_method="bruteforce", key=jax.random.key(1),
+            perplexity=cfg.perplexity, assembly=assembly, cache=cache,
+            knn_tiles=knn_tiles, on_stage=on_stage),
+        on_stage=lambda st, secs, cs: stages.append((st, cs)))
+    faults.activate(None)
+    assert prep.label == "blocks"  # the ladder's affinity demotion
+    # first attempt computed knn cold, died in affinities; the relaunch
+    # loaded knn warm and only recomputed affinities
+    assert stages == [("knn", "cold"), ("knn", "warm"),
+                      ("affinities", "cold")]
+
+
+def test_on_oom_fail_propagates(tmp_path):
+    x, cfg = problem(), small_cfg()
+    faults.activate("oom@knn:1")
+    sup = Supervisor(run_plan_from_fit(x.shape[0], x.shape[1], 15, cfg,
+                                       "auto", "bruteforce"),
+                     on_oom="fail")
+    with pytest.raises(faults.InjectedOom):
+        supervised_embed(x, cfg, supervisor=sup, neighbors=15, seed=0)
+
+
+# ---- divergence sentinel ---------------------------------------------------
+
+def sentinel_problem():
+    from tsne_flink_tpu.models.tsne import init_working_set
+    from tsne_flink_tpu.ops.affinities import (joint_distribution,
+                                               pairwise_affinities)
+    from tsne_flink_tpu.ops.knn import knn_bruteforce
+    x = problem(40)
+    idx, dist = knn_bruteforce(x, 8)
+    jidx, jval = joint_distribution(idx, pairwise_affinities(dist, 4.0))
+    st = init_working_set(jax.random.key(0), 40, 2, x.dtype)
+    return st, jidx, jval
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_sentinel_rolls_back_seeded_nan_and_converges(n_devices):
+    """Acceptance: a seeded-NaN segment rolls back to the segment-start
+    state and the run converges through the eta-halving retry — single
+    device and on the real 8-device CPU mesh."""
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    st, jidx, jval = sentinel_problem()
+    cfg = small_cfg(30)
+    faults.activate("nan@optimize:seg2")
+    events = []
+    run = ShardedOptimizer(cfg, 40, n_devices=n_devices)
+    out, losses = run(st, jidx, jval, checkpoint_every=10,
+                      checkpoint_cb=lambda *a: None, health_check=True,
+                      events=events)
+    faults.activate(None)
+    assert np.isfinite(np.asarray(out.y)).all()
+    assert np.isfinite(np.asarray(losses)).all()
+    assert [e["type"] for e in events] == ["sentinel-rollback"]
+    assert events[0]["segment_start"] == 10  # segment 2 starts at iter 10
+    assert events[0]["eta_after"] == events[0]["eta_before"] / 2
+    assert run.cfg.learning_rate == cfg.learning_rate / 2
+
+
+def test_sentinel_without_faults_is_bit_identical():
+    """health_check=True on a healthy run must not change a single bit
+    (the sentinel flag rides the carry; the update math is untouched)."""
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    st, jidx, jval = sentinel_problem()
+    cfg = small_cfg(30)
+    y0, l0 = ShardedOptimizer(cfg, 40, n_devices=1)(st, jidx, jval)
+    y1, l1 = ShardedOptimizer(cfg, 40, n_devices=1)(
+        st, jidx, jval, checkpoint_every=10,
+        checkpoint_cb=lambda *a: None, health_check=True)
+    np.testing.assert_array_equal(np.asarray(y0.y), np.asarray(y1.y))
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+
+
+def test_sentinel_bounded_retries():
+    """Retries are bounded: with zero retries left, a poisoned segment
+    raises DivergenceError instead of looping."""
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    st, jidx, jval = sentinel_problem()
+    faults.activate("nan@optimize:seg1")
+    run = ShardedOptimizer(small_cfg(30), 40, n_devices=1)
+    with pytest.raises(DivergenceError, match="sentinel retries"):
+        run(st, jidx, jval, checkpoint_every=10,
+            checkpoint_cb=lambda *a: None, health_check=True,
+            health_retries=0)
+    faults.activate(None)
+
+
+# ---- estimator API wiring --------------------------------------------------
+
+def test_api_health_check_fit_records_events():
+    from tsne_flink_tpu.models.api import TSNE
+    x = np.asarray(problem(50))
+    t = TSNE(n_iter=30, perplexity=5.0, repulsion="exact",
+             health_check=True)
+    t.fit(x)
+    assert np.isfinite(t.embedding_).all()
+    assert t.runtime_events_ == []  # healthy run: armed, nothing fired
+    with pytest.raises(ValueError, match="on_oom"):
+        TSNE(on_oom="explode")
+
+
+def test_api_fault_routes_through_supervised_path(tmp_path):
+    from tsne_flink_tpu.models.api import TSNE
+    x = np.asarray(problem(50))
+    faults.activate("oom@knn:1")
+    t = TSNE(n_iter=30, perplexity=5.0, repulsion="exact",
+             cache_dir=str(tmp_path))
+    t.fit(x)
+    faults.activate(None)
+    assert np.isfinite(t.embedding_).all()
+    assert [d["action"] for d in t.degradations_] == ["shrink-knn-tiles"]
+
+
+# ---- verified checkpoint rollback ------------------------------------------
+
+def ckpt_state(n=5):
+    from tsne_flink_tpu.models.tsne import TsneState
+    rng = np.random.default_rng(3)
+    y = jnp.asarray(rng.normal(size=(n, 2)))
+    return TsneState(y=y, update=jnp.zeros_like(y), gains=jnp.ones_like(y))
+
+
+def test_checkpoint_bitflip_detected_with_path_and_hash(tmp_path):
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+    p = str(tmp_path / "c.npz")
+    ckpt.save(p, ckpt_state(), 10, np.asarray([1.0]))
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:  # flip one payload bit
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0x10]))
+    with pytest.raises(ckpt.CheckpointCorrupt) as e:
+        ckpt.load(p)
+    assert p in str(e.value) and e.value.expected_hash  # names path + hash
+    # CheckpointCorrupt is a NotACheckpoint/ValueError: old handlers hold
+    assert isinstance(e.value, ckpt.NotACheckpoint)
+
+
+def test_checkpoint_truncation_detected(tmp_path):
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+    p = str(tmp_path / "c.npz")
+    ckpt.save(p, ckpt_state(), 10, np.asarray([1.0]))
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 3)
+    with pytest.raises(ckpt.CheckpointCorrupt, match="corrupt"):
+        ckpt.load(p)
+
+
+def test_checkpoint_rotation_fallback(tmp_path):
+    """keep-last-2: a corrupt newest file degrades to the previous one
+    with a warning instead of crashing."""
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+    p = str(tmp_path / "c.npz")
+    st = ckpt_state()
+    ckpt.save(p, st, 10, np.asarray([1.0]))
+    faults.activate("corrupt@checkpoint")  # bit-flips the NEXT write
+    ckpt.save(p, st, 20, np.asarray([2.0]))
+    faults.activate(None)
+    assert os.path.exists(p + ".1")
+    state, it, losses, used = ckpt.load_fallback(p)
+    assert used == p + ".1" and it == 10
+    np.testing.assert_array_equal(state.y, np.asarray(st.y))
+    # with no predecessor the corruption surfaces
+    os.remove(p + ".1")
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_fallback(p)
+
+
+# ---- atomic output writes (satellite) --------------------------------------
+
+def test_atomic_write_cleans_up_on_failure(tmp_path):
+    from tsne_flink_tpu.utils.io import atomic_write
+    target = str(tmp_path / "out.csv")
+    with open(target, "w") as f:
+        f.write("previous-good\n")
+
+    def boom(tmp):
+        with open(tmp, "w") as f:
+            f.write("half-writ")
+        raise OSError("disk full")
+
+    with pytest.raises(OSError):
+        atomic_write(target, boom)
+    with open(target) as f:  # the old file survives intact
+        assert f.read() == "previous-good\n"
+    assert os.listdir(str(tmp_path)) == ["out.csv"]  # no tmp litter
+
+
+def test_loss_and_embedding_writes_are_atomic(tmp_path):
+    from tsne_flink_tpu.utils import io as tio
+    loss_p = str(tmp_path / "loss.txt")
+    emb_p = str(tmp_path / "emb.csv")
+    tio.write_loss(loss_p, np.asarray([1.5, 2.5]))
+    tio.write_embedding(emb_p, np.arange(3), np.ones((3, 2)))
+    assert sorted(os.listdir(str(tmp_path))) == ["emb.csv", "loss.txt"]
+    assert np.loadtxt(loss_p, delimiter=",", ndmin=2).shape == (2, 2)
+    assert np.loadtxt(emb_p, delimiter=",", ndmin=2).shape == (3, 3)
+
+
+# ---- CLI: kill + resume bit-identity (acceptance, real SIGKILL) ------------
+
+def _write_input(tmp, n=40, d=6):
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(3, d)) * 4.0
+    x = centers[rng.integers(0, 3, n)] + rng.normal(size=(n, d))
+    inp = os.path.join(tmp, "in.csv")
+    with open(inp, "w") as f:
+        for i in range(n):
+            for j in range(d):
+                f.write(f"{i},{j},{float(x[i, j])!r}\n")
+    return inp
+
+
+def _cli(args, tmp, check=True):
+    env = dict(os.environ, TSNE_FORCE_CPU="1", TSNE_ARTIFACTS="0")
+    env.pop("TSNE_FAULT_PLAN", None)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from tsne_flink_tpu.utils.cli import main; "
+         "sys.exit(main(sys.argv[1:]))"] + args,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    if check:
+        assert r.returncode == 0, r.stderr[-2000:]
+    return r
+
+
+def test_cli_kill_at_segment_boundary_resume_bit_identical(tmp_path):
+    """Acceptance: kill@optimize:seg1 SIGKILLs the run right after the
+    first segment's checkpoint; --resume then reproduces the
+    uninterrupted run's embedding byte for byte."""
+    tmp = str(tmp_path)
+    inp = _write_input(tmp)
+    ck = os.path.join(tmp, "ck.npz")
+    base = ["--input", inp, "--dimension", "6", "--knnMethod", "bruteforce",
+            "--perplexity", "5", "--dtype", "float64", "--noCache",
+            "--iterations", "30"]
+    # uninterrupted reference
+    ref_out = os.path.join(tmp, "ref.csv")
+    _cli(base + ["--output", ref_out,
+                 "--loss", os.path.join(tmp, "rl.txt")], tmp)
+    # killed run: SIGKILL fires AFTER the iteration-10 checkpoint
+    out = os.path.join(tmp, "out.csv")
+    r = _cli(base + ["--output", out, "--loss", os.path.join(tmp, "l.txt"),
+                     "--checkpoint", ck, "--checkpointEvery", "10",
+                     "--faultPlan", "kill@optimize:seg1"], tmp, check=False)
+    assert r.returncode == -9, (r.returncode, r.stderr[-500:])
+    assert not os.path.exists(out)  # died mid-run, no torn output
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+    _, it, _ = ckpt.load(ck)
+    assert it == 10
+    # resume completes and matches the uninterrupted run bit for bit
+    _cli(base + ["--output", out, "--loss", os.path.join(tmp, "l.txt"),
+                 "--checkpoint", ck, "--checkpointEvery", "10",
+                 "--resume", ck], tmp)
+    with open(ref_out, "rb") as f1, open(out, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_cli_fault_oom_ladder_and_events_in_checkpoint(tmp_path):
+    """--faultPlan oom@knn:1 completes via the ladder and the final
+    checkpoint's payload carries the structured event history."""
+    tmp = str(tmp_path)
+    inp = _write_input(tmp)
+    ck = os.path.join(tmp, "ck.npz")
+    cache = os.path.join(tmp, "cache")
+    r = _cli(["--input", inp, "--output", os.path.join(tmp, "out.csv"),
+              "--dimension", "6", "--knnMethod", "bruteforce",
+              "--perplexity", "5", "--dtype", "float64",
+              "--loss", os.path.join(tmp, "l.txt"), "--iterations", "20",
+              "--cacheDir", cache, "--checkpoint", ck,
+              "--faultPlan", "oom@knn:1"], tmp)
+    assert "supervisor: OOM in 'knn'" in r.stderr
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+    payload = ckpt.load_prepare(ck)
+    events = json.loads(payload["events"])
+    assert [e["type"] for e in events["events"]] == ["oom", "degrade"]
+    assert [d["action"] for d in events["degradations"]] == [
+        "shrink-knn-tiles"]
+
+
+# ---- bench: ladder demotion recorded, deterministically (acceptance) -------
+
+def _run_bench(tmp, extra_env):
+    env = dict(os.environ, TSNE_FORCE_CPU="1", TSNE_BENCH_WRAPPED="1",
+               TSNE_ARTIFACTS="1", TSNE_ARTIFACT_DIR=os.path.join(tmp, "art"))
+    for knob in ("TSNE_BENCH_T0", "TSNE_BENCH_DEADLINE_S", "TSNE_BENCH_SEG",
+                 "TSNE_AFFINITY_ASSEMBLY", "TSNE_TUNNEL_DOWN",
+                 "TSNE_FAULT_PLAN"):
+        env.pop(knob, None)
+    env.update(extra_env)
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                        "400", "20"], capture_output=True, text=True,
+                       env=env, cwd=tmp, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert recs
+    return recs[-1]
+
+
+def test_bench_oom_at_knn_completes_with_recorded_demotion(tmp_path):
+    """Acceptance: with TSNE_FAULT_PLAN=oom@knn:1 the bench completes via
+    the ladder instead of crashing, and the record carries the tile
+    demotion — twice, with identical degradation lists (determinism)."""
+    rec1 = _run_bench(str(tmp_path),
+                      {"TSNE_FAULT_PLAN": "oom@knn:1",
+                       "TSNE_ARTIFACT_DIR": str(tmp_path / "art1")})
+    assert rec1["degradations"], "no ladder step in the bench record"
+    assert rec1["degradations"][0]["action"] == "shrink-knn-tiles"
+    assert [e["type"] for e in rec1["runtime_events"]] == ["oom", "degrade"]
+    assert "partial" not in rec1 and rec1["final_kl"] is not None
+    rec2 = _run_bench(str(tmp_path),
+                      {"TSNE_FAULT_PLAN": "oom@knn:1",
+                       "TSNE_ARTIFACT_DIR": str(tmp_path / "art2")})
+    assert rec1["degradations"] == rec2["degradations"]

@@ -25,6 +25,11 @@ once (ADVICE/VERDICT rounds 1-5); the linter catches it forever:
   ``@jax.jit``-decorated) declares a dtype contract in
   ``analysis/audit/contracts.py``, so the graftcheck dtype-contract
   auditor has full coverage of the jitted surface.
+* ``exception-hygiene`` — a bare ``except:`` or ``except Exception`` in
+  ``ops/``, ``models/`` or ``runtime/`` that swallows (no re-raise, no
+  log) hides real failures from the recovery machinery (the supervisor
+  can only ladder an OOM it sees); such handlers must re-raise, log, or
+  carry a rationale'd suppression.
 
 Rules are pure-AST project passes registered with :func:`core.rule`; they
 never import the code under analysis.
@@ -575,6 +580,10 @@ CLI_ONLY_FLAGS = {
     # launch-control gate, not a model hyper-parameter: the estimator runs
     # in-process where the caller can invoke the audit API directly
     "auditPlan",
+    # fault-injection test harness (runtime/faults.py): a process-level
+    # testing knob, not a model hyper-parameter; in-process callers use
+    # runtime.faults.activate() / $TSNE_FAULT_PLAN directly
+    "faultPlan",
 }
 
 #: estimator-only kwargs with no CLI counterpart (none at present; the
@@ -683,6 +692,72 @@ def cli_api_parity(project: Project):
             f"TSNE kwarg '{kwarg}' has no CLI flag counterpart: add the "
             "flag to utils/cli.py, or add it to API_ONLY_KWARGS with the "
             "rationale"))
+    return findings
+
+
+# ---- rule: exception-hygiene -----------------------------------------------
+
+#: attribute/function names whose call inside a handler counts as logging
+#: the failure (print to stderr, warnings.warn, any logging-level method)
+_LOG_CALL_NAMES = {"print"}
+_LOG_ATTR_NAMES = {"warn", "warning", "error", "exception", "critical",
+                   "info", "debug"}
+
+
+def _is_broad_handler(node: ast.ExceptHandler) -> bool:
+    """bare ``except:`` or ``except (Base)Exception`` — including tuple
+    forms that contain one."""
+    t = node.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(isinstance(nm, ast.Name)
+               and nm.id in ("Exception", "BaseException") for nm in names)
+
+
+def _handler_surfaces(node: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises or logs the failure somewhere a
+    human (or the supervisor) can see it."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Raise):
+            return True
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Name) and func.id in _LOG_CALL_NAMES:
+            return True
+        if isinstance(func, ast.Attribute) and func.attr in _LOG_ATTR_NAMES:
+            return True
+    return False
+
+
+@rule("exception-hygiene",
+      "broad except handlers in ops//models//runtime/ must re-raise, log, "
+      "or carry a rationale'd suppression")
+def exception_hygiene(project: Project):
+    findings = []
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        in_scope = any(f"/{d}/" in norm or norm.startswith(f"{d}/")
+                       for d in ("ops", "models", "runtime"))
+        if not in_scope:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_handler(node):
+                continue
+            if _handler_surfaces(node):
+                continue
+            what = ("bare except:" if node.type is None
+                    else "except Exception")
+            findings.append(mod.finding(
+                "exception-hygiene", node,
+                f"{what} swallows the failure (no re-raise, no log): a "
+                "silent catch here hides real errors from the runtime "
+                "recovery layer (supervisor/ladder) and from operators — "
+                "narrow the exception, re-raise, log it, or suppress with "
+                "the rationale"))
     return findings
 
 

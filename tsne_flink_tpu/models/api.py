@@ -40,7 +40,9 @@ class TSNE:
                  sym_strict: bool = False, bh_gate: str = "vdm",
                  dtype: str | None = None,
                  affinity_assembly: str | None = None,
-                 cache_dir: str | None = None):
+                 cache_dir: str | None = None,
+                 max_retries: int = 2, on_oom: str = "ladder",
+                 health_check: bool = False):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -116,9 +118,22 @@ class TSNE:
         # sweeps, backend A/Bs) skip the expensive prepare stage.  None
         # disables — a LIBRARY must not write to disk unasked.
         self.cache_dir = cache_dir
+        # runtime resilience (tsne_flink_tpu/runtime/, CLI parity with
+        # --maxRetries/--onOom/--healthCheck): on_oom="ladder" degrades the
+        # plan on device OOM and refits; health_check=True runs the fit
+        # through the supervised segmented path with the divergence
+        # sentinel (rollback + eta-halving on NaN/Inf).  Recovery events
+        # land in ``runtime_events_`` / ``degradations_`` after fit.
+        if on_oom not in ("ladder", "fail"):
+            raise ValueError(f"on_oom '{on_oom}' not defined (ladder | fail)")
+        self.max_retries = max_retries
+        self.on_oom = on_oom
+        self.health_check = health_check
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
+        self.runtime_events_ = None
+        self.degradations_ = None
 
     def _config(self, n: int) -> TsneConfig:
         from tsne_flink_tpu.utils.cli import pick_repulsion
@@ -200,12 +215,17 @@ class TSNE:
                                 sym_strict=self.sym_strict,
                                 n_devices=self.devices,
                                 artifact_cache=cache)
-            if cache is not None and jax.process_count() == 1:
+            if ((cache is not None or self.health_check)
+                    and jax.process_count() == 1):
                 # the segmented prepare+optimize form (same results as the
                 # fused program) is the one whose prepare() half the
-                # artifact cache can skip
+                # artifact cache can skip — and the one whose segment
+                # boundaries the divergence sentinel rolls back to
+                self.runtime_events_ = []
                 state, losses = pipe.run_checkpointable(
-                    x, jax.random.key(self.random_state))
+                    x, jax.random.key(self.random_state),
+                    health_check=self.health_check,
+                    events=self.runtime_events_)
                 y = state.y
             else:
                 y, losses = pipe(x, jax.random.key(self.random_state))
@@ -215,8 +235,23 @@ class TSNE:
                 from jax.experimental import multihost_utils
                 y = multihost_utils.process_allgather(y, tiled=True)[:n]
         else:
-            y, losses = tsne_embed(
-                x, cfg, neighbors=self.neighbors, knn_method=self.knn_method,
+            from tsne_flink_tpu.runtime import faults
+            from tsne_flink_tpu.runtime.supervisor import (
+                Supervisor, is_oom, run_plan_from_fit, supervised_embed)
+            k = (self.neighbors if self.neighbors is not None
+                 else 3 * int(cfg.perplexity))
+            sup = Supervisor(
+                run_plan_from_fit(x.shape[0], x.shape[1], k, cfg,
+                                  self.affinity_assembly or "auto",
+                                  self.knn_method,
+                                  knn_rounds=self.knn_iterations,
+                                  knn_refine=self.knn_refine,
+                                  sym_width=self.sym_width,
+                                  name="estimator-fit"),
+                max_retries=self.max_retries, on_oom=self.on_oom,
+                health_check=self.health_check)
+            embed_kwargs = dict(
+                neighbors=self.neighbors, knn_method=self.knn_method,
                 knn_blocks=(self.knn_blocks if self.knn_blocks is not None
                             else jax.device_count()),
                 knn_iterations=self.knn_iterations,
@@ -225,6 +260,27 @@ class TSNE:
                 sym_width=self.sym_width,
                 affinity_assembly=self.affinity_assembly,
                 artifact_cache=self._artifact_cache())
+            if self.health_check or faults.injector() is not None:
+                # supervised segmented path: the sentinel (and fault
+                # injection) need segment boundaries to roll back to
+                y, losses = supervised_embed(x, cfg, supervisor=sup,
+                                             **embed_kwargs)
+            else:
+                try:
+                    # the unsupervised fast path is byte-for-byte the
+                    # pre-resilience pipeline
+                    y, losses = tsne_embed(x, cfg, **embed_kwargs)
+                except Exception as e:
+                    if self.on_oom != "ladder" or not is_oom(e):
+                        raise
+                    sup.events.append({"type": "oom", "stage": "fit",
+                                       "error": str(e)[:200]})
+                    # refit through the supervised path, whose
+                    # stage-granular ladder degrades the plan
+                    y, losses = supervised_embed(x, cfg, supervisor=sup,
+                                                 **embed_kwargs)
+            self.runtime_events_ = list(sup.events)
+            self.degradations_ = sup.degradations
         self.embedding_ = np.asarray(y)
         self.kl_trace_ = np.asarray(losses)
         self.kl_divergence_ = (float(self.kl_trace_[-1])

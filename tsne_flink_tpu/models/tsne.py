@@ -277,7 +277,8 @@ def center_input(x: jnp.ndarray, axis_name=None, valid=None) -> jnp.ndarray:
 def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
              axis_name=None, row_offset=0, valid=None,
              start_iter=0, num_iters: int | None = None,
-             loss_carry=None, edges=None, edges_extra=False):
+             loss_carry=None, edges=None, edges_extra=False,
+             with_health=False):
     """Full 3-phase gradient descent as ONE compiled fori_loop.
 
     Returns (final TsneState, loss trace [iterations // 10]); trace slot t is
@@ -290,6 +291,14 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
     exaggeration gates and loss slots all key off the absolute iteration, so
     segmented runs are bit-identical to one full run.  ``loss_carry`` threads
     the partially-filled loss trace between segments.
+
+    ``with_health`` (static) arms the divergence sentinel: a finiteness
+    flag over (y, gains, KL) is AND-accumulated in the SAME loop carry —
+    no extra host syncs, no extra collectives inside the loop (shards
+    combine the scalar with one psum after it) — and returned as a third
+    output the segment runner reads once per boundary
+    (``runtime/health.py`` holds the rollback policy).  With the default
+    ``False`` the program is unchanged, bit for bit.
     """
     m0 = jnp.asarray(cfg.initial_momentum, state.y.dtype)
     m1 = jnp.asarray(cfg.final_momentum, state.y.dtype)
@@ -302,7 +311,10 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
                   else lax.all_gather(valid, axis_name, tiled=True))
 
     def body(i, carry):
-        st, loss_arr = carry
+        if with_health:
+            st, loss_arr, ok = carry
+        else:
+            st, loss_arr = carry
         momentum = jnp.where(i < cfg.momentum_switch, m0, m1)
         exag = jnp.where(i < cfg.exaggeration_end, alpha, one)
         grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
@@ -317,12 +329,25 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
         record = (i + 1) % LOSS_EVERY == 0
         loss_arr = loss_arr.at[slot].set(
             jnp.where(record, loss, loss_arr[slot]))
+        if with_health:
+            # divergence sentinel: the shard-local finite check rides the
+            # carry (loss is already globally psum'd by _gradient)
+            ok = (ok & jnp.all(jnp.isfinite(st.y))
+                  & jnp.all(jnp.isfinite(st.gains)) & jnp.isfinite(loss))
+            return st, loss_arr, ok
         return st, loss_arr
 
     loss0 = (loss_carry if loss_carry is not None
              else jnp.zeros((n_slots,), state.y.dtype))
     num = cfg.iterations if num_iters is None else num_iters
     start = jnp.asarray(start_iter, jnp.int32)
+    if with_health:
+        state, losses, ok = lax.fori_loop(
+            start, start + num, body, (state, loss0, jnp.asarray(True)))
+        # one scalar collective AFTER the loop makes the flag global (and
+        # replication-invariant under shard_map out_specs P())
+        bad = _psum((~ok).astype(jnp.int32), axis_name)
+        return state, losses, bad == 0
     state, losses = lax.fori_loop(start, start + num, body, (state, loss0))
     return state, losses
 

@@ -80,15 +80,18 @@ class ShardedOptimizer:
 
     def _segment_fn(self, num_iters: int, with_edges: bool = False,
                     trace_edge_pad: int | None = None,
-                    edges_extra: bool = False):
+                    edges_extra: bool = False, with_health: bool = False):
         """``with_edges``: host-prebuilt edge arrays ride as extra inputs.
         ``trace_edge_pad``: the edge conversion instead runs IN-TRACE on each
         shard's local rows (static pad per shard) — the only form available
         to multi-controller runs, whose hosts cannot slice the
         non-addressable global rows (VERDICT r3 weak #2).  ``edges_extra``:
         the split-blocks layout (jidx/jval are the width-k forward block,
-        the edge arrays the reverse-only block; attraction sums both)."""
-        key = (num_iters, with_edges, trace_edge_pad, edges_extra)
+        the edge arrays the reverse-only block; attraction sums both).
+        ``with_health``: the segment additionally returns the divergence
+        sentinel's replicated finiteness flag (models/tsne.optimize)."""
+        key = (num_iters, with_edges, trace_edge_pad, edges_extra,
+               with_health)
         if key in self._fns:
             return self._fns[key]
         cfg_ = self.cfg
@@ -98,7 +101,8 @@ class ShardedOptimizer:
             # the deadline-stop resume (bench.py cb keeps prog["state"]), so
             # donation would hand XLA a buffer the host still reads
             fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters,
-                                 edges_extra=edges_extra))
+                                 edges_extra=edges_extra,
+                                 with_health=with_health))
         else:
             n_local = self.n_local
 
@@ -112,19 +116,23 @@ class ShardedOptimizer:
                                 row_offset=row_offset, valid=valid,
                                 start_iter=start_iter, num_iters=num_iters,
                                 loss_carry=loss_carry, edges=edges,
-                                edges_extra=edges_extra)
+                                edges_extra=edges_extra,
+                                with_health=with_health)
 
             pspec = P(AXIS)
             state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
             in_specs = [state_spec, pspec, pspec, pspec, P(), P()]
             if with_edges:
                 in_specs.append((pspec, pspec, pspec))
+            # loss trace (and the sentinel flag) are psum-replicated
+            out_specs = ((state_spec, P(), P()) if with_health
+                         else (state_spec, P()))
             from tsne_flink_tpu.utils.compat import shard_map
             fn = jax.jit(
                 shard_map(
                     local_run, mesh=self.mesh,
                     in_specs=tuple(in_specs),
-                    out_specs=(state_spec, P()),  # loss trace psum-replicated
+                    out_specs=out_specs,
                 ))
         self._fns[key] = fn
         return fn
@@ -272,10 +280,25 @@ class ShardedOptimizer:
     def __call__(self, state: TsneState, jidx, jval, *, start_iter: int = 0,
                  loss_carry=None, checkpoint_every: int = 0,
                  checkpoint_cb=None, pre_padded_valid=None, unpad: bool = True,
-                 edge_pad: int | None = None, extra_edges=None):
+                 edge_pad: int | None = None, extra_edges=None,
+                 health_check: bool = False, health_retries: int = 3,
+                 events: list | None = None):
         """Run iterations [start_iter, cfg.iterations); if checkpointing,
         ``checkpoint_cb(state, next_iter, losses)`` fires every
         ``checkpoint_every`` iterations with the UNPADDED state.
+
+        ``health_check`` arms the divergence sentinel: each segment also
+        returns an on-device finiteness flag over (y, gains, KL)
+        (``models/tsne.optimize(with_health=True)`` — the flag rides the
+        loop carry, so no host syncs are added inside a segment); it is
+        read ONCE at the segment boundary, and a non-finite segment rolls
+        back to the segment-start state and retries with halved eta and a
+        fresh momentum buffer (``runtime/health.py``), bounded by
+        ``health_retries`` in total.  Rollbacks are appended to ``events``
+        (when given) as structured dicts.  The fault-injection hooks
+        (``runtime/faults.py``) also live in this loop: ``nan@optimize``
+        poisons a segment's input state, ``kill@optimize:segN`` SIGKILLs
+        at the boundary after segment N's checkpoint.
 
         Multi-controller callers pass arrays that are ALREADY padded global
         jax.Arrays (host-side pad/slice of non-addressable arrays is
@@ -340,23 +363,69 @@ class ShardedOptimizer:
                      else self._shard_reverse_block(extra_edges))
         else:
             edges = self._build_edges(jidx, jval)
+        from tsne_flink_tpu.runtime import faults
+        inj = faults.injector()
         total = self.cfg.iterations
         seg = (checkpoint_every if checkpoint_every
                and checkpoint_cb is not None else total - start_iter)
         it = start_iter
+        seg_index = 0
+        retries_left = health_retries
         while it < total:
             step = min(seg, total - it)
             if step <= 0:
                 break
             fn = self._segment_fn(step, with_edges=edges is not None,
                                   trace_edge_pad=trace_pad,
-                                  edges_extra=extra_edges is not None)
-            state, losses = self._run_segment(fn, state, jidx, jval, valid,
-                                              it, losses, edges)
+                                  edges_extra=extra_edges is not None,
+                                  with_health=health_check)
+            seg_index += 1
+            run_state = state
+            if inj is not None:
+                f = inj.fire("optimize", seg=seg_index, point="start")
+                if f is not None and f.kind == "nan":
+                    # poison the segment's INPUT; the pre-segment `state`
+                    # stays clean, so the sentinel's rollback is exercised
+                    # end to end
+                    run_state = run_state._replace(
+                        y=run_state.y.at[0, 0].set(jnp.nan))
+            out = self._run_segment(fn, run_state, jidx, jval, valid,
+                                    it, losses, edges)
+            if health_check:
+                new_state, new_losses, ok = out
+                if not bool(ok):  # ONE host scalar read, at the boundary
+                    from tsne_flink_tpu.runtime import health as rhealth
+                    if retries_left <= 0:
+                        raise rhealth.DivergenceError(it, health_retries)
+                    retries_left -= 1
+                    seg_index -= 1  # the retry re-runs the same segment
+                    eta = self.cfg.learning_rate
+                    self.cfg = rhealth.halved_eta(self.cfg)
+                    self._fns.clear()  # cfg changed: segment fns retrace
+                    state = rhealth.fresh_momentum(state)
+                    ev = rhealth.rollback_event(
+                        segment_start=it, step=step, eta_before=eta,
+                        eta_after=self.cfg.learning_rate,
+                        retries_left=retries_left)
+                    if events is not None:
+                        events.append(ev)
+                    import sys
+                    print(f"# sentinel: non-finite segment at iteration "
+                          f"{it}; rolled back, eta {eta} -> "
+                          f"{self.cfg.learning_rate}, retrying",
+                          file=sys.stderr)
+                    continue
+                state, losses = new_state, new_losses
+            else:
+                state, losses = out
             it += step
             if checkpoint_cb is not None and it < total:
                 checkpoint_cb(self._unpad(state) if unpad else state,
                               it, losses)
+            if inj is not None:
+                # kill@optimize:segN — AFTER the boundary's checkpoint, so
+                # the resume contract is what the kill exercises
+                inj.fire("optimize", seg=seg_index, point="boundary")
         return (self._unpad(state) if unpad else state), losses
 
 

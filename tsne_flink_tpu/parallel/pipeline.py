@@ -501,10 +501,18 @@ class SpmdPipeline:
 
     def run_checkpointable(self, x, key, *, start_iter: int = 0,
                            loss_carry=None, resume_state: TsneState | None = None,
-                           checkpoint_every: int = 0, checkpoint_cb=None):
+                           checkpoint_every: int = 0, checkpoint_cb=None,
+                           health_check: bool = False,
+                           health_retries: int = 3, events: list | None = None):
         """prepare() + the segmented ShardedOptimizer (same mesh): gives
         --spmd runs the same checkpoint/resume semantics as the host-staged
         pipeline, returning the full ``(TsneState, losses)``.
+
+        ``health_check`` / ``health_retries`` / ``events`` arm the
+        divergence sentinel in the segmented runner (the CLI's
+        ``--healthCheck``; see ``parallel/mesh.ShardedOptimizer``) — the
+        flag is computed on-device inside each sharded segment, so the
+        sentinel costs the sharded path nothing extra per iteration.
 
         kNN/affinities are deterministic in (x, key, cfg), so a resumed run
         recomputes P bit-identically; the optimizer state itself comes from
@@ -530,7 +538,10 @@ class SpmdPipeline:
             return self._runner(state, jidx, jval, start_iter=start_iter,
                                 loss_carry=loss_carry,
                                 checkpoint_every=checkpoint_every,
-                                checkpoint_cb=checkpoint_cb)
+                                checkpoint_cb=checkpoint_cb,
+                                health_check=health_check,
+                                health_retries=health_retries,
+                                events=events)
 
         # ---- multi-controller: no host pad/slice of global arrays anywhere
         while True:
@@ -569,7 +580,9 @@ class SpmdPipeline:
                             loss_carry=loss_carry,
                             checkpoint_every=checkpoint_every,
                             checkpoint_cb=cb, pre_padded_valid=valid,
-                            unpad=False, edge_pad=max(8, (e + 7) // 8 * 8))
+                            unpad=False, edge_pad=max(8, (e + 7) // 8 * 8),
+                            health_check=health_check,
+                            health_retries=health_retries, events=events)
 
     def __call__(self, x, key):
         """Fused fast path: the whole job in one compiled sharded program.

@@ -1,0 +1,29 @@
+"""Runtime resilience layer: fault injection, OOM degradation ladder,
+divergence sentinel, and the run supervisor that wires them together.
+
+The reference Flink job inherits fault tolerance from the dataflow runtime
+(task restarts, checkpoint barriers — SURVEY §5); the JAX/TPU port has to
+build its own.  This package is that layer:
+
+* :mod:`tsne_flink_tpu.runtime.faults`     — deterministic fault injection
+  (``TSNE_FAULT_PLAN``) so every recovery path is exercised on CPU in
+  tier-1, no TPU required;
+* :mod:`tsne_flink_tpu.runtime.ladder`     — the OOM degradation ladder,
+  consulting the graftcheck HBM model for the next-cheaper plan;
+* :mod:`tsne_flink_tpu.runtime.health`     — divergence-sentinel policy
+  (rollback, eta halving, fresh momentum);
+* :mod:`tsne_flink_tpu.runtime.supervisor` — the run supervisor wrapping
+  prepare + optimize end-to-end, consumed by the CLI, bench.py and the
+  estimator API.
+
+Deliberately import-light: nothing here imports JAX at module level, so
+the fault hooks in hot paths cost one attribute check when no plan is
+active.
+"""
+
+from tsne_flink_tpu.runtime.faults import FaultInjector, InjectedOom, injector
+from tsne_flink_tpu.runtime.ladder import Degradation, OomLadder
+from tsne_flink_tpu.runtime.supervisor import Supervisor, is_oom
+
+__all__ = ["Degradation", "FaultInjector", "InjectedOom", "OomLadder",
+           "Supervisor", "injector", "is_oom"]

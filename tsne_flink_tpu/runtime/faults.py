@@ -1,0 +1,185 @@
+"""Deterministic fault injection — every recovery path exercised on CPU.
+
+A fault plan is a comma-separated list of ``<kind>@<site>[:<trigger>]``
+clauses, read from ``TSNE_FAULT_PLAN`` (or the CLI's ``--faultPlan``):
+
+====== ======================= ==========================================
+kind   example                 effect at the instrumented site
+====== ======================= ==========================================
+oom    ``oom@knn:1``           raise a synthetic ``RESOURCE_EXHAUSTED``
+                               (:class:`InjectedOom`) on the Nth entry
+kill   ``kill@optimize:seg2``  SIGKILL the process at the chosen optimize
+                               segment boundary (after its checkpoint)
+corrupt ``corrupt@checkpoint`` bit-flip the just-written file
+nan    ``nan@optimize:seg1``   poison the segment's input state with NaN
+                               (the caller applies it — see :meth:`fire`)
+====== ======================= ==========================================
+
+Triggers: a bare integer is the Nth call of that site (1-based, default
+1); ``segN`` matches the optimize segment number.  Each fault fires at
+most once, and the whole plan is a pure function of the call sequence —
+same plan + same run = same faults, which is what the ladder-determinism
+test pins.
+
+Instrumented sites: ``knn`` and ``affinities`` (stage entries in
+``utils/artifacts.prepare``), ``optimize`` (segment start for oom/nan,
+segment boundary for kill — ``parallel/mesh.ShardedOptimizer``), and
+``checkpoint`` (after the atomic write in ``utils/checkpoint.save``).
+Each hook is one ``injector()`` read — None when no plan is active, so
+production runs pay a single module-attribute check.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from dataclasses import dataclass, field
+
+KINDS = ("oom", "kill", "corrupt", "nan")
+SITES = ("knn", "affinities", "optimize", "checkpoint")
+
+#: where in a segment each optimize-site kind fires: oom/nan at segment
+#: start (so the recovery path sees the failure before any work is
+#: committed), kill at the boundary (after the checkpoint is written —
+#: the resume contract is what the kill exercises).
+POINT_FOR_KIND = {"oom": "start", "nan": "start", "kill": "boundary",
+                  "corrupt": "boundary"}
+
+
+class InjectedOom(RuntimeError):
+    """Synthetic device OOM — message mirrors the real XLA error text so
+    :func:`tsne_flink_tpu.runtime.supervisor.is_oom` treats both alike."""
+
+    def __init__(self, site: str):
+        self.site = site
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory at stage "
+            f"'{site}' (TSNE_FAULT_PLAN)")
+
+
+@dataclass
+class Fault:
+    """One parsed ``kind@site[:trigger]`` clause."""
+
+    kind: str
+    site: str
+    trigger: str          # "N" (Nth site call) or "segN" (optimize)
+    fired: bool = False
+
+    def matches(self, count: int, seg: int | None) -> bool:
+        if self.trigger.startswith("seg"):
+            return seg is not None and seg == int(self.trigger[3:])
+        n = int(self.trigger)
+        # a segment-indexed site treats a bare integer as the segment
+        # number; occurrence counters cover the plain stage sites
+        return seg == n if seg is not None else count == n
+
+
+def parse_plan(spec: str) -> list[Fault]:
+    """Parse a fault-plan string; raises ValueError on a malformed clause
+    (fail-fast: a typo'd plan must not silently inject nothing)."""
+    faults = []
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            kind, rest = clause.split("@", 1)
+        except ValueError:
+            raise ValueError(f"fault clause '{clause}' is not "
+                             "kind@site[:trigger]") from None
+        site, _, trigger = rest.partition(":")
+        kind, site = kind.strip(), site.strip()
+        trigger = trigger.strip() or "1"
+        if kind not in KINDS:
+            raise ValueError(f"fault kind '{kind}' not defined "
+                             f"({' | '.join(KINDS)})")
+        if site not in SITES:
+            raise ValueError(f"fault site '{site}' not defined "
+                             f"({' | '.join(SITES)})")
+        if not (trigger.isdigit()
+                or (trigger.startswith("seg") and trigger[3:].isdigit())):
+            raise ValueError(f"fault trigger '{trigger}' is not an "
+                             "occurrence count or segN")
+        faults.append(Fault(kind, site, trigger))
+    return faults
+
+
+def _flip_bit(path: str) -> None:
+    """Flip one bit in the middle of ``path`` — the corrupt@ payload.
+    Deterministic (fixed offset), and deliberately NOT a truncation: a
+    bit-flip is the case only a content hash catches."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    off = size // 2
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0x40]))
+
+
+@dataclass
+class FaultInjector:
+    """Stateful injector over one parsed plan; site-call counters make
+    integer triggers deterministic."""
+
+    faults: list[Fault] = field(default_factory=list)
+    counts: dict = field(default_factory=dict)
+    log: list = field(default_factory=list)  # fired (kind, site, trigger)
+
+    def fire(self, site: str, *, seg: int | None = None,
+             path: str | None = None, point: str = "start"):
+        """Check (and execute) any due fault at ``site``.
+
+        Returns the triggering :class:`Fault` for kinds the CALLER must
+        apply (``nan`` — the injector cannot reach the optimizer state),
+        else None.  ``oom`` raises, ``kill`` never returns, ``corrupt``
+        mutates ``path`` in place."""
+        self.counts[site] = self.counts.get(site, 0) + (
+            1 if seg is None else 0)
+        result = None
+        for f in self.faults:
+            if f.fired or f.site != site:
+                continue
+            if POINT_FOR_KIND[f.kind] != point:
+                continue
+            if not f.matches(self.counts.get(site, 0), seg):
+                continue
+            f.fired = True
+            self.log.append((f.kind, f.site, f.trigger))
+            if f.kind == "oom":
+                raise InjectedOom(site)
+            if f.kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            if f.kind == "corrupt" and path is not None:
+                _flip_bit(path)
+            if f.kind == "nan":
+                result = f
+        return result
+
+
+_INJECTOR: FaultInjector | None = None
+_LOADED = False
+
+
+def injector() -> FaultInjector | None:
+    """The process-global injector, or None when no plan is active.
+    Resolved once from ``TSNE_FAULT_PLAN``; :func:`activate` overrides
+    (CLI ``--faultPlan``, tests)."""
+    global _INJECTOR, _LOADED
+    if not _LOADED:
+        from tsne_flink_tpu.utils.env import env_str
+        spec = env_str("TSNE_FAULT_PLAN")
+        _INJECTOR = FaultInjector(parse_plan(spec)) if spec else None
+        _LOADED = True
+    return _INJECTOR
+
+
+def activate(spec: str | None) -> FaultInjector | None:
+    """Install a fault plan programmatically (None deactivates)."""
+    global _INJECTOR, _LOADED
+    _INJECTOR = FaultInjector(parse_plan(spec)) if spec else None
+    _LOADED = True
+    return _INJECTOR

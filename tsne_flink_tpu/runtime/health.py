@@ -1,0 +1,61 @@
+"""Divergence-sentinel policy: rollback, eta halving, fresh momentum.
+
+The mechanism lives on-device: ``models/tsne.optimize(with_health=True)``
+AND-accumulates a finiteness flag over (Y, gains, KL) in the existing
+fori_loop carry — zero extra host syncs inside a segment (the flag is one
+scalar in the compiled program, combined across shards by a single psum
+after the loop; the ``transfer_guard`` pin in tests/test_optimizer.py
+covers the compiled segment).  ``ShardedOptimizer`` reads that flag once
+per segment boundary — a point that already syncs for checkpointing —
+and applies THIS module's policy on failure:
+
+* roll back to the segment-start state (the last good checkpoint);
+* halve the learning rate (the known early-exaggeration overflow,
+  ``models/tsne.py`` ``_attractive_forces`` docstring, is an eta/force
+  balance blow-up — halving eta is the classical fix);
+* reset the momentum buffer (a diverged ``update`` carries the blow-up's
+  direction into the retry) while keeping the adaptive gains;
+* retry the same segment, bounded by ``health_retries``.
+
+The halved eta persists for the remainder of the run — restoring the
+original rate would re-create the conditions that diverged — and every
+rollback is a structured event on the supervisor's event list, so the
+bench record and checkpoint carry the run's degradation history.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+
+def halved_eta(cfg):
+    """The retry config: same schedule, half the learning rate."""
+    return replace(cfg, learning_rate=cfg.learning_rate / 2.0)
+
+
+def fresh_momentum(state):
+    """Zero the update buffer (keep y and the adaptive gains): the
+    momentum term is the only carry that remembers the diverged step's
+    direction."""
+    import jax.numpy as jnp
+    return state._replace(update=jnp.zeros_like(state.update))
+
+
+def rollback_event(*, segment_start: int, step: int, eta_before: float,
+                   eta_after: float, retries_left: int) -> dict:
+    """Structured record of one sentinel rollback (supervisor event list)."""
+    return {"type": "sentinel-rollback", "stage": "optimize",
+            "segment_start": int(segment_start), "segment_iters": int(step),
+            "eta_before": float(eta_before), "eta_after": float(eta_after),
+            "retries_left": int(retries_left)}
+
+
+class DivergenceError(RuntimeError):
+    """Raised when the sentinel's bounded retries are exhausted and the
+    segment still produces non-finite state."""
+
+    def __init__(self, start_iter: int, retries: int):
+        super().__init__(
+            f"optimize segment at iteration {start_iter} still non-finite "
+            f"after {retries} sentinel retries (eta halved each time); "
+            "lower --learningRate or --earlyExaggeration")

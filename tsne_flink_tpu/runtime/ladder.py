@@ -1,0 +1,171 @@
+"""OOM degradation ladder — on device OOM, pick the next-cheaper plan.
+
+Graftcheck (``analysis/audit/hbm.py``) predicts plan-level OOM statically;
+this module is the runtime half for what prediction misses.  When a stage
+dies with ``RESOURCE_EXHAUSTED``, the ladder consults the same HBM model
+to choose the next-cheaper plan and the supervisor relaunches only the
+failed stage (the artifact cache keeps the completed stages' outputs):
+
+1. **shrink the kNN tile budget** (halve ``pick_knn_tiles``'s working-set
+   budget, up to twice) — recall-invariant by the tile planner's contract,
+   so it is always the first rung;
+2. **switch affinity assembly to ``blocks``** — the memory-flat layout
+   that never materializes the hub-widened [N, S] rows (the recorded
+   round-5 1M OOM fix);
+3. **demote repulsion** exact → bh → fft — each step trades the dense
+   [chunk, N] distance tile for a strictly smaller frontier/grid
+   working set (quality changes, which is why it is the LAST rung and
+   every demotion is recorded in the bench record / checkpoint).
+
+Every step is recorded as a :class:`Degradation` carrying the HBM model's
+predicted peak before/after where the model can express the change, so a
+post-mortem can see both what the ladder did and why it believed the step
+would help.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+#: repulsion demotion chain (ladder rung 3); fft is the floor.
+REPULSION_DEMOTION = {"exact": "bh", "bh": "fft"}
+
+#: how many times rung 1 may halve the tile budget before escalating.
+MAX_TILE_SHRINKS = 2
+
+
+@dataclass(frozen=True)
+class Degradation:
+    """One recorded ladder step (rides bench records and checkpoints)."""
+
+    seq: int
+    stage: str        # the stage whose OOM triggered the step
+    action: str       # shrink-knn-tiles | assembly-blocks | repulsion-demote
+    before: object
+    after: object
+    peak_hbm_before: int | None = None  # HBM-model prediction, when
+    peak_hbm_after: int | None = None   # expressible for this action
+
+    def as_dict(self) -> dict:
+        return {"seq": self.seq, "stage": self.stage, "action": self.action,
+                "before": self.before, "after": self.after,
+                "peak_hbm_before": self.peak_hbm_before,
+                "peak_hbm_after": self.peak_hbm_after}
+
+
+def _predicted_peak(plan) -> int | None:
+    """plan-level peak-HBM estimate from the graftcheck model; None when
+    the model cannot evaluate the plan (never expected, but a broken
+    audit import must not turn a recovery into a crash)."""
+    try:
+        from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
+        return int(plan_hbm_report(plan)["peak_hbm_est"])
+    except Exception as e:
+        import sys
+        print(f"WARNING: HBM model unavailable for the ladder "
+              f"({type(e).__name__}: {e}); degrading blind", file=sys.stderr)
+        return None
+
+
+class OomLadder:
+    """Degradation state machine over one run's
+    :class:`~tsne_flink_tpu.analysis.audit.plan.PlanConfig`.
+
+    :meth:`demote` picks the next untried rung applicable to the failed
+    stage and returns its :class:`Degradation` (None when exhausted);
+    :meth:`overrides` is the accumulated override set the relaunch applies
+    (``knn_tiles`` / ``assembly`` for ``utils/artifacts.prepare``,
+    ``repulsion`` for the optimizer config).
+    """
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.tile_shrinks = 0
+        self.knn_tiles = None        # KnnTilePlan override, rung 1
+        self.assembly = None         # "blocks" once rung 2 fires
+        self.repulsion = None        # demoted backend once rung 3 fires
+        self.degradations: list[Degradation] = []
+
+    # ---- rungs -------------------------------------------------------------
+
+    def _shrink_tiles(self, stage: str) -> Degradation | None:
+        if self.tile_shrinks >= MAX_TILE_SHRINKS:
+            return None
+        from tsne_flink_tpu.ops.knn_tiles import (DEFAULT_BUDGET_BYTES,
+                                                  _FALLBACK_BUDGET,
+                                                  pick_knn_tiles)
+        p = self.plan
+        base = DEFAULT_BUDGET_BYTES.get(p.backend, _FALLBACK_BUDGET)
+        before = (self.knn_tiles or pick_knn_tiles(
+            p.n, p.d, p.k, p.backend, hbm_bytes=base >> self.tile_shrinks))
+        self.tile_shrinks += 1
+        budget = base >> self.tile_shrinks
+        after = replace(pick_knn_tiles(p.n, p.d, p.k, p.backend,
+                                       hbm_bytes=budget), source="override")
+        self.knn_tiles = after
+        return Degradation(
+            seq=len(self.degradations), stage=stage,
+            action="shrink-knn-tiles",
+            before={"budget": base >> (self.tile_shrinks - 1),
+                    **before.as_record()},
+            after={"budget": budget, **after.as_record()})
+
+    def _assembly_blocks(self, stage: str) -> Degradation | None:
+        if self.assembly == "blocks":
+            return None
+        cur = self.plan.resolved_assembly()
+        if cur == "blocks":
+            return None  # already memory-flat; nothing cheaper on this rung
+        peak0 = _predicted_peak(self.plan)
+        self.plan = replace(self.plan, assembly="blocks")
+        self.assembly = "blocks"
+        return Degradation(
+            seq=len(self.degradations), stage=stage,
+            action="assembly-blocks", before=cur, after="blocks",
+            peak_hbm_before=peak0, peak_hbm_after=_predicted_peak(self.plan))
+
+    def _repulsion_demote(self, stage: str) -> Degradation | None:
+        cur = self.repulsion or self.plan.resolved_repulsion()
+        nxt = REPULSION_DEMOTION.get(cur)
+        if nxt is None:
+            return None
+        peak0 = _predicted_peak(self.plan)
+        self.plan = replace(self.plan, repulsion=nxt)
+        self.repulsion = nxt
+        return Degradation(
+            seq=len(self.degradations), stage=stage,
+            action="repulsion-demote", before=cur, after=nxt,
+            peak_hbm_before=peak0, peak_hbm_after=_predicted_peak(self.plan))
+
+    # ---- public ------------------------------------------------------------
+
+    def demote(self, stage: str) -> Degradation | None:
+        """The next ladder step for an OOM in ``stage``; records and
+        returns it (None = ladder exhausted for that stage)."""
+        if stage == "knn":
+            rungs = (self._shrink_tiles, self._assembly_blocks)
+        elif stage == "affinities":
+            rungs = (self._assembly_blocks,)
+        else:
+            # optimize: only the repulsion working set can shrink without
+            # re-running a completed prepare stage (assembly is baked into
+            # the P arrays the optimizer already holds)
+            rungs = (self._repulsion_demote,)
+        for rung in rungs:
+            deg = rung(stage)
+            if deg is not None:
+                self.degradations.append(deg)
+                return deg
+        return None
+
+    def overrides(self) -> dict:
+        """Accumulated prepare-stage overrides for the relaunch."""
+        out = {}
+        if self.knn_tiles is not None:
+            out["knn_tiles"] = self.knn_tiles
+        if self.assembly is not None:
+            out["assembly"] = self.assembly
+        return out
+
+    def records(self) -> list[dict]:
+        return [d.as_dict() for d in self.degradations]

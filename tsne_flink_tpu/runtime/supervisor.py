@@ -1,0 +1,241 @@
+"""The run supervisor: prepare + optimize end-to-end with recovery.
+
+One :class:`Supervisor` wraps one run.  It owns the OOM ladder
+(:mod:`tsne_flink_tpu.runtime.ladder`), threads the divergence sentinel's
+flags into the segmented optimizer, captures the last good (state, iter,
+losses) at every checkpoint boundary so an OOM relaunch resumes from the
+failed stage instead of zero, and logs every recovery decision as a
+structured event — the list rides the bench record (``degradations`` /
+``runtime_events``) and the v2 checkpoint payload (``events``), so a
+resumed run knows its own degradation history.
+
+Consumed by ``utils/cli.py`` (``--maxRetries`` / ``--onOom`` /
+``--healthCheck``), ``bench.py`` (env-driven: ``TSNE_MAX_RETRIES`` /
+``TSNE_ON_OOM`` / ``TSNE_HEALTH_CHECK``) and ``models/api.py`` (the
+estimator kwargs of the same names).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tsne_flink_tpu.runtime.ladder import OomLadder
+
+#: substrings identifying a device out-of-memory error across the ways
+#: XLA/PJRT spell it (plus the injected synthetic form, whose message
+#: carries RESOURCE_EXHAUSTED by construction).
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM when allocating")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True for device allocation failures (real XlaRuntimeError or the
+    injected synthetic) — the only exception class the ladder handles."""
+    return any(m in str(exc) for m in _OOM_MARKERS)
+
+
+class LadderExhausted(RuntimeError):
+    def __init__(self, stage: str, cause: BaseException):
+        super().__init__(
+            f"device OOM in the '{stage}' stage and the degradation ladder "
+            f"is exhausted (original error: {cause})")
+
+
+class Supervisor:
+    """Recovery policy around one run.
+
+    ``plan`` is the run's graftcheck PlanConfig (the ladder's input);
+    ``on_oom="fail"`` disables the ladder (OOMs propagate), ``max_retries``
+    bounds ladder relaunches per phase, ``health_check`` arms the
+    divergence sentinel in the segmented optimizer.
+    """
+
+    def __init__(self, plan=None, *, max_retries: int = 2,
+                 on_oom: str = "ladder", health_check: bool = False,
+                 health_retries: int = 3, events: list | None = None):
+        if on_oom not in ("ladder", "fail"):
+            raise ValueError(f"on_oom '{on_oom}' not defined (ladder | fail)")
+        self.ladder = OomLadder(plan) if plan is not None else None
+        self.max_retries = int(max_retries)
+        self.on_oom = on_oom
+        self.health_check = bool(health_check)
+        self.health_retries = int(health_retries)
+        self.events: list = events if events is not None else []
+        # last good optimizer snapshot, updated at checkpoint boundaries
+        self._last = None
+
+    # ---- shared ladder plumbing -------------------------------------------
+
+    def _handle_oom(self, stage: str, exc: BaseException, attempt: int):
+        """Record the OOM and pick the ladder step, or re-raise."""
+        if (self.on_oom != "ladder" or self.ladder is None
+                or attempt >= self.max_retries or not is_oom(exc)):
+            raise exc
+        self.events.append({"type": "oom", "stage": stage,
+                            "error": str(exc)[:200]})
+        deg = self.ladder.demote(stage)
+        if deg is None:
+            raise LadderExhausted(stage, exc) from exc
+        self.events.append({"type": "degrade", **deg.as_dict()})
+        print(f"# supervisor: OOM in '{stage}' — {deg.action} "
+              f"({deg.before!r} -> {deg.after!r}), relaunching the stage",
+              file=sys.stderr)
+        return deg
+
+    @property
+    def degradations(self) -> list:
+        """Ladder steps taken so far, as JSON-safe dicts (bench record)."""
+        return self.ladder.records() if self.ladder is not None else []
+
+    def summary(self) -> dict:
+        return {"events": list(self.events),
+                "degradations": self.degradations}
+
+    # ---- prepare ----------------------------------------------------------
+
+    def run_prepare(self, fn, on_stage=None):
+        """Run the prepare stage with ladder recovery.
+
+        ``fn(on_stage=..., **overrides)`` must run the stage (normally a
+        lambda over ``utils/artifacts.prepare``); overrides are the
+        ladder's accumulated ``knn_tiles`` / ``assembly``.  The failed
+        stage is identified from the ``on_stage`` completion callbacks,
+        and — because prepare's artifact cache content-addresses each
+        stage — the relaunch recomputes only the stage that died."""
+        for attempt in range(self.max_retries + 1):
+            done: list = []
+
+            def track(stage, secs, cache_state, _done=done):
+                _done.append(stage)
+                if on_stage is not None:
+                    on_stage(stage, secs, cache_state)
+
+            overrides = (self.ladder.overrides()
+                         if self.ladder is not None else {})
+            try:
+                return fn(on_stage=track, **overrides)
+            # graftlint: disable=exception-hygiene -- not a swallow:
+            # _handle_oom re-raises everything that is not a
+            # ladder-eligible device OOM (and logs the step it takes)
+            except Exception as e:
+                stage = "affinities" if "knn" in done else "knn"
+                self._handle_oom(stage, e, attempt)
+        raise AssertionError("unreachable: _handle_oom raises or demotes")
+
+    # ---- optimize ---------------------------------------------------------
+
+    def optimize_cfg(self, cfg):
+        """``cfg`` with any ladder repulsion demotion applied."""
+        if self.ladder is not None and self.ladder.repulsion is not None:
+            from dataclasses import replace
+            return replace(cfg, repulsion=self.ladder.repulsion)
+        return cfg
+
+    def run_optimize(self, make_runner, cfg, state, jidx, jval, *,
+                     start_iter: int = 0, loss_carry=None,
+                     checkpoint_every: int = 0, checkpoint_cb=None,
+                     extra_edges=None):
+        """Segmented optimize with OOM-ladder relaunch and the sentinel.
+
+        ``make_runner(cfg)`` builds a ``ShardedOptimizer``-compatible
+        runner for the (possibly demoted) config.  The supervisor shims
+        the checkpoint callback to capture the last good snapshot, so a
+        repulsion demotion relaunches from the last segment boundary —
+        not from iteration 0."""
+        import numpy as np
+
+        self._last = {"state": state, "it": start_iter,
+                      "losses": loss_carry}
+
+        def cb(st, next_iter, losses):
+            self._last = {"state": st, "it": next_iter,
+                          "losses": np.asarray(losses)}
+            if checkpoint_cb is not None:
+                checkpoint_cb(st, next_iter, losses)
+
+        for attempt in range(self.max_retries + 1):
+            runner = make_runner(self.optimize_cfg(cfg))
+            try:
+                return runner(self._last["state"], jidx, jval,
+                              start_iter=self._last["it"],
+                              loss_carry=self._last["losses"],
+                              checkpoint_every=checkpoint_every,
+                              checkpoint_cb=cb, extra_edges=extra_edges,
+                              health_check=self.health_check,
+                              health_retries=self.health_retries,
+                              events=self.events)
+            # graftlint: disable=exception-hygiene -- not a swallow:
+            # _handle_oom re-raises everything that is not a
+            # ladder-eligible device OOM (and logs the step it takes)
+            except Exception as e:
+                self._handle_oom("optimize", e, attempt)
+                self.events.append(
+                    {"type": "relaunch", "stage": "optimize",
+                     "from_iter": int(self._last["it"]),
+                     "repulsion": self.optimize_cfg(cfg).repulsion})
+        raise AssertionError("unreachable: _handle_oom raises or demotes")
+
+
+def run_plan_from_fit(n: int, d: int, k: int, cfg, assembly: str,
+                      knn_method: str, knn_rounds=None, knn_refine=None,
+                      sym_width=None, name: str = "fit"):
+    """A graftcheck PlanConfig for an in-process fit — the estimator's
+    analog of the CLI's ``_run_plan`` (the ladder's input)."""
+    import jax
+
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    return PlanConfig(
+        n=int(n), d=int(d), k=int(k), backend=jax.default_backend(),
+        n_components=cfg.n_components, iterations=cfg.iterations,
+        knn_method=knn_method, knn_rounds=knn_rounds, knn_refine=knn_refine,
+        repulsion=cfg.repulsion, theta=cfg.theta, assembly=assembly,
+        attraction=cfg.attraction, sym_width=sym_width,
+        row_chunk=cfg.row_chunk, name=name)
+
+
+def supervised_embed(x, cfg, *, supervisor: Supervisor,
+                     neighbors: int | None = None,
+                     knn_method: str = "bruteforce", knn_iterations=None,
+                     knn_refine=None, knn_blocks: int = 8, seed: int = 0,
+                     sym_width=None, affinity_assembly=None,
+                     artifact_cache=None, knn_autotune: bool = False):
+    """Supervised single-device pipeline: ``models/tsne.tsne_embed`` with
+    the supervisor wrapped around prepare and a segmented optimizer run
+    (the sentinel needs segment boundaries to roll back to).  Same key
+    derivation and prepare plan as ``tsne_embed``; the optimize loop runs
+    through ``ShardedOptimizer`` on one device — the same compiled
+    program, segmented."""
+    import jax
+
+    from tsne_flink_tpu.models.tsne import LOSS_EVERY, init_working_set
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    from tsne_flink_tpu.utils.artifacts import prepare as prepare_stage
+    from tsne_flink_tpu.utils.env import env_str
+
+    n = x.shape[0]
+    k = neighbors if neighbors is not None else 3 * int(cfg.perplexity)
+    key = jax.random.key(seed)
+    kkey, ikey = jax.random.split(key)
+    if affinity_assembly is None:
+        affinity_assembly = env_str("TSNE_AFFINITY_ASSEMBLY")
+    if affinity_assembly == "auto" and sym_width is not None:
+        affinity_assembly = "sorted"  # mirror tsne_embed's pinned-width rule
+
+    prep = supervisor.run_prepare(
+        lambda on_stage, assembly=affinity_assembly, knn_tiles=None:
+        prepare_stage(x, neighbors=k, knn_method=knn_method,
+                      metric=cfg.metric, knn_rounds=knn_iterations,
+                      knn_refine=knn_refine, knn_blocks=knn_blocks,
+                      key=kkey, perplexity=cfg.perplexity,
+                      assembly=assembly, sym_width=sym_width,
+                      cache=artifact_cache, knn_autotune=knn_autotune,
+                      knn_tiles=knn_tiles, on_stage=on_stage))
+
+    state = init_working_set(ikey, n, cfg.n_components, x.dtype)
+    iters = cfg.iterations
+    seg = max(LOSS_EVERY, min(50, iters // 10 or iters))
+    state, losses = supervisor.run_optimize(
+        lambda c: ShardedOptimizer(c, n, n_devices=1), cfg, state,
+        prep.jidx, prep.jval, extra_edges=prep.extra_edges,
+        checkpoint_every=seg, checkpoint_cb=lambda *a: None)
+    return state.y, losses

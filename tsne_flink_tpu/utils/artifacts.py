@@ -340,7 +340,9 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
     import jax.numpy as jnp
 
     from tsne_flink_tpu.ops.knn import knn as knn_dispatch
+    from tsne_flink_tpu.runtime import faults
 
+    inj = faults.injector()  # fault hooks: None (one check) in production
     if assembly not in ("auto", "sorted", "split", "blocks"):
         raise ValueError(f"assembly '{assembly}' not defined "
                          "(auto | sorted | split | blocks)")
@@ -355,6 +357,8 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
 
     # ---- kNN graph ----
     t0 = time.time()
+    if inj is not None:
+        inj.fire("knn")
     knn_subs = tiles_rec = None
     if knn is not None:
         idx, dist = knn
@@ -398,6 +402,8 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
 
     # ---- affinities: beta search + symmetrized assembly ----
     t1 = time.time()
+    if inj is not None:
+        inj.fire("affinities")
     got = (cache.load(KIND_AFFINITY, affinity_fp, ("label", "jidx", "jval"))
            if affinity_fp is not None else None)
     label = str(got["label"]) if got is not None else None

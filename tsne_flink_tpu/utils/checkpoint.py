@@ -1,8 +1,9 @@
-"""Checkpoint / resume of the optimizer working set — v2: prepare-aware.
+"""Checkpoint / resume of the optimizer working set — v2: prepare-aware,
+content-verified, rotating.
 
 The reference has NO checkpointing — a failed Flink job recomputes everything
 from CSV (SURVEY §5 "Checkpoint / resume: absent").  Here the full working set
-(y, lastUpdate, gains — the reference's 4-tuple minus the index column), the
+(y, lastUpdate, gains — the reference's 4-tuple minus the id column), the
 next iteration number, and the partial loss trace are saved as one ``.npz``;
 resuming reproduces the uninterrupted run bit-for-bit because the segmented
 optimizer keys every schedule gate off the absolute iteration
@@ -17,11 +18,26 @@ checkpoints — the assembled P arrays themselves, so ``--resume`` runs zero
 kNN/β-search/symmetrization work before the first optimize iteration.
 v1 files stay loadable (:func:`load` accepts both magics; their payload is
 simply absent and the caller recomputes, exactly as before).
+
+Verified rollback (the runtime-resilience PR):
+
+* every :func:`save` embeds a sha256 **content hash** over all saved
+  arrays; :func:`load` recomputes and compares, so a bit-flipped or
+  truncated file raises :class:`CheckpointCorrupt` naming the path and
+  the expected hash instead of surfacing a numpy traceback (or, worse,
+  silently resuming from damaged state);
+* writes are atomic (tmp + rename, as before) AND **rotating**: the
+  previous checkpoint survives as ``<path>.1`` (keep-last-2), so
+  :func:`load_fallback` can degrade to the last good file with a warning
+  when the newest one is corrupt — a crash mid-rotation leaves at worst
+  a missing ``<path>`` with an intact ``<path>.1``.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import sys
 import tempfile
 
 import numpy as np
@@ -34,82 +50,161 @@ _MAGICS = (MAGIC_V1, MAGIC)
 
 #: array names a prepare payload may carry (stored with a ``prep_`` prefix
 #: so they can never collide with working-set keys).  ``affinity_fp``,
-#: ``label`` and ``audit`` are strings (``audit`` is the JSON-encoded
-#: graftcheck plan summary — --auditPlan's {peak_hbm_est, hbm_budget,
-#: compile_count} — so a resume can detect a config whose predicted
-#: footprint drifted from the run that wrote the file); the rest are the
-#: artifact arrays themselves (``jidx``/``jval`` plus the blocks triple
-#: when label == "blocks").
-PREPARE_KEYS = ("affinity_fp", "label", "audit", "jidx", "jval",
+#: ``label``, ``audit`` and ``events`` are strings (``audit`` is the
+#: JSON-encoded graftcheck plan summary — --auditPlan's {peak_hbm_est,
+#: hbm_budget, compile_count} — so a resume can detect a config whose
+#: predicted footprint drifted; ``events`` is the JSON-encoded supervisor
+#: event/degradation history, so a resumed run knows what recoveries the
+#: run that wrote the file already performed); the rest are the artifact
+#: arrays themselves (``jidx``/``jval`` plus the blocks triple when
+#: label == "blocks").
+PREPARE_KEYS = ("affinity_fp", "label", "audit", "events", "jidx", "jval",
                 "rsrc", "rdst", "rval")
-
-
-def save(path: str, state: TsneState, next_iter: int,
-         losses: np.ndarray, prepare: dict | None = None) -> None:
-    """Atomic write (tmp + rename) so an interrupt never corrupts the file.
-
-    ``prepare`` (optional) is the v2 payload dict — any subset of
-    :data:`PREPARE_KEYS`; pass the artifact arrays too for a fat checkpoint
-    whose resume needs no artifact cache at all."""
-    extras = {}
-    for k, v in (prepare or {}).items():
-        if k not in PREPARE_KEYS:
-            raise ValueError(f"unknown prepare payload key '{k}' "
-                             f"({' | '.join(PREPARE_KEYS)})")
-        extras["prep_" + k] = np.asarray(v)
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, magic=MAGIC, y=np.asarray(state.y),
-                     update=np.asarray(state.update),
-                     gains=np.asarray(state.gains),
-                     next_iter=int(next_iter), losses=np.asarray(losses),
-                     **extras)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
 
 
 class NotACheckpoint(ValueError):
     pass
 
 
+class CheckpointCorrupt(NotACheckpoint):
+    """The file claims to be a checkpoint but its bytes are damaged
+    (truncation, bit-flip, torn write) — names the path and, when the
+    trailer could be read, the expected content hash."""
+
+    def __init__(self, path: str, expected: str | None = None,
+                 detail: str = ""):
+        self.path = path
+        self.expected_hash = expected
+        msg = f"checkpoint {path} is corrupt"
+        if expected:
+            msg += f" (expected content hash {expected})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+def _content_hash(arrays: dict) -> str:
+    """sha256 over every saved array's (name, dtype, shape, bytes) in
+    sorted-name order — the verification trailer."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(np.asarray(arrays[name]))
+        h.update(repr((name, a.dtype.str, a.shape)).encode())
+        h.update(a.view(np.uint8).reshape(-1).data)
+    return h.hexdigest()
+
+
+def save(path: str, state: TsneState, next_iter: int,
+         losses: np.ndarray, prepare: dict | None = None,
+         keep: int = 2) -> None:
+    """Atomic, verified, rotating write.
+
+    tmp + rename so an interrupt never corrupts the file; a sha256
+    content hash over every array is embedded for :func:`load` to verify;
+    with ``keep=2`` (default) the previous checkpoint is rotated to
+    ``<path>.1`` first, so a later-corrupted newest file still has a good
+    predecessor for :func:`load_fallback`.  ``prepare`` (optional) is the
+    v2 payload dict — any subset of :data:`PREPARE_KEYS`; pass the
+    artifact arrays too for a fat checkpoint whose resume needs no
+    artifact cache at all."""
+    extras = {}
+    for k, v in (prepare or {}).items():
+        if k not in PREPARE_KEYS:
+            raise ValueError(f"unknown prepare payload key '{k}' "
+                             f"({' | '.join(PREPARE_KEYS)})")
+        extras["prep_" + k] = np.asarray(v)
+    payload = {"magic": np.asarray(MAGIC), "y": np.asarray(state.y),
+               "update": np.asarray(state.update),
+               "gains": np.asarray(state.gains),
+               "next_iter": np.asarray(int(next_iter)),
+               "losses": np.asarray(losses), **extras}
+    digest = _content_hash(payload)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, content_hash=digest, **payload)
+        if keep > 1 and os.path.exists(path):
+            os.replace(path, path + ".1")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    from tsne_flink_tpu.runtime import faults
+    inj = faults.injector()
+    if inj is not None:  # corrupt@checkpoint bit-flips the file just written
+        inj.fire("checkpoint", path=path, point="boundary")
+
+
+def _open_verified(path: str):
+    """np.load + magic/content-hash verification; returns the NpzFile.
+    Foreign files raise :class:`NotACheckpoint`, damaged ones
+    :class:`CheckpointCorrupt` (the caller closes the file)."""
+    import zipfile
+    try:
+        z = np.load(path)
+    except (OSError, ValueError, EOFError, zipfile.BadZipFile) as e:
+        raise CheckpointCorrupt(path, detail=f"unreadable ({e})") from e
+    try:
+        if str(z["magic"]) not in _MAGICS:
+            raise NotACheckpoint(f"{path} is not a tsne_flink_tpu checkpoint")
+        if "content_hash" in z.files:
+            expected = str(z["content_hash"])
+            try:
+                arrays = {name: z[name] for name in z.files
+                          if name != "content_hash"}
+            except Exception as e:
+                raise CheckpointCorrupt(path, expected,
+                                        f"payload unreadable ({e})") from e
+            if _content_hash(arrays) != expected:
+                raise CheckpointCorrupt(path, expected,
+                                        "content hash mismatch")
+        return z
+    except NotACheckpoint:
+        z.close()
+        raise
+    except (ValueError, KeyError, OSError, zipfile.BadZipFile, EOFError) as e:
+        z.close()
+        raise CheckpointCorrupt(path, detail=str(e)) from e
+
+
 def load(path: str):
     """Returns (TsneState (numpy arrays), next_iter, losses) — v1 AND v2
-    files (the prepare payload, if any, is read by :func:`load_prepare`)."""
-    try:
-        with np.load(path) as z:
-            if str(z["magic"]) not in _MAGICS:
-                raise NotACheckpoint(f"{path} is not a tsne_flink_tpu checkpoint")
+    files (the prepare payload, if any, is read by :func:`load_prepare`).
+    Verifies the content hash when the file carries one."""
+    with _open_verified(path) as z:
+        try:
             state = TsneState(y=z["y"], update=z["update"], gains=z["gains"])
             return state, int(z["next_iter"]), z["losses"]
-    except NotACheckpoint:
-        raise
-    except (ValueError, KeyError, OSError) as e:
-        raise NotACheckpoint(
-            f"{path} is not a tsne_flink_tpu checkpoint ({e})") from e
+        except (ValueError, KeyError) as e:
+            raise CheckpointCorrupt(path, detail=str(e)) from e
+
+
+def load_fallback(path: str):
+    """:func:`load` with keep-last-2 degradation: a corrupt newest file
+    falls back to the rotated ``<path>.1`` with a warning instead of
+    crashing the resume.  Returns (state, next_iter, losses, used_path)."""
+    try:
+        return (*load(path), path)
+    except CheckpointCorrupt as e:
+        prev = path + ".1"
+        if not os.path.exists(prev):
+            raise
+        print(f"WARNING: {e}; falling back to the previous checkpoint "
+              f"{prev}", file=sys.stderr)
+        return (*load(prev), prev)
 
 
 def load_prepare(path: str) -> dict | None:
     """The v2 prepare payload of ``path`` as a dict (strings for
-    ``affinity_fp``/``label``, numpy arrays otherwise), or None for a v1
-    file / a v2 file saved without one."""
-    try:
-        with np.load(path) as z:
-            if str(z["magic"]) not in _MAGICS:
-                raise NotACheckpoint(f"{path} is not a tsne_flink_tpu checkpoint")
-            out = {}
-            for k in PREPARE_KEYS:
-                name = "prep_" + k
-                if name in z.files:
-                    v = z[name]
-                    out[k] = str(v) if v.dtype.kind == "U" else v
-            return out or None
-    except NotACheckpoint:
-        raise
-    except (ValueError, KeyError, OSError) as e:
-        raise NotACheckpoint(
-            f"{path} is not a tsne_flink_tpu checkpoint ({e})") from e
+    ``affinity_fp``/``label``/``audit``/``events``, numpy arrays
+    otherwise), or None for a v1 file / a v2 file saved without one."""
+    with _open_verified(path) as z:
+        out = {}
+        for k in PREPARE_KEYS:
+            name = "prep_" + k
+            if name in z.files:
+                v = z[name]
+                out[k] = str(v) if v.dtype.kind == "U" else v
+        return out or None

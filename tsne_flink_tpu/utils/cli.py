@@ -163,6 +163,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the prepare-artifact cache (always "
                         "recompute kNN + affinities); $TSNE_ARTIFACTS=0 "
                         "sets the same default")
+    # --- runtime resilience (tsne_flink_tpu/runtime/) ---
+    p.add_argument("--maxRetries", type=int, default=2,
+                   help="how many degradation-ladder relaunches the run "
+                        "supervisor may attempt per phase after a device "
+                        "OOM (runtime/supervisor.py)")
+    p.add_argument("--onOom", default="ladder", choices=["ladder", "fail"],
+                   help="device-OOM policy: 'ladder' consults the "
+                        "graftcheck HBM model and degrades the plan "
+                        "(shrink kNN tiles -> blocks assembly -> demote "
+                        "repulsion exact->bh->fft), relaunching only the "
+                        "failed stage from cached artifacts; 'fail' "
+                        "propagates the OOM")
+    p.add_argument("--healthCheck", action="store_true",
+                   help="arm the divergence sentinel: a per-segment "
+                        "on-device finite-check on (Y, gains, KL); a "
+                        "non-finite segment rolls back to the last good "
+                        "state and retries with halved eta and a fresh "
+                        "momentum buffer (bounded retries)")
+    p.add_argument("--faultPlan", default=None,
+                   help="fault-injection plan for recovery testing "
+                        "(runtime/faults.py grammar, e.g. "
+                        "'oom@knn:1,kill@optimize:seg2'); same as "
+                        "$TSNE_FAULT_PLAN")
     p.add_argument("--auditPlan", nargs="?", const="fail", default=None,
                    choices=["fail", "warn"],
                    help="run the graftcheck plan audit (static per-stage "
@@ -364,16 +387,34 @@ def _load_resume(args, dtype):
 
     if not args.resume:
         return 0, None, None, None
-    st_np, start_iter, loss_carry = ckpt.load(args.resume)
+    # verified load with keep-last-2 degradation: a corrupt/truncated
+    # newest file falls back to the rotated predecessor with a warning
+    # (utils/checkpoint.load_fallback) instead of a numpy traceback
+    st_np, start_iter, loss_carry, used = ckpt.load_fallback(args.resume)
     state = TsneState(y=jnp.asarray(st_np.y, dtype),
                       update=jnp.asarray(st_np.update, dtype),
                       gains=jnp.asarray(st_np.gains, dtype))
-    payload = ckpt.load_prepare(args.resume)
-    print(f"resumed from {args.resume} at iteration {start_iter}")
+    payload = ckpt.load_prepare(used)
+    print(f"resumed from {used} at iteration {start_iter}")
     return start_iter, loss_carry, state, payload
 
 
-def _make_checkpoint_cb(args, prepare_payload=None):
+def _payload_with_events(prepare_payload, supervisor, prior):
+    """The checkpoint payload, with the supervisor's CURRENT event/
+    degradation history serialized in — evaluated at save time, so every
+    checkpoint carries the recoveries that happened before it (and a
+    resumed run's history chains via ``prior``)."""
+    payload = dict(prepare_payload or {})
+    if supervisor is not None:
+        summary = supervisor.summary()
+        if prior:
+            summary["prior"] = prior
+        payload["events"] = json.dumps(summary)
+    return payload
+
+
+def _make_checkpoint_cb(args, prepare_payload=None, supervisor=None,
+                        prior_events=None):
     """Periodic-checkpoint callback for --checkpoint/--checkpointEvery."""
     if not (args.checkpoint and args.checkpointEvery > 0):
         return None
@@ -383,19 +424,22 @@ def _make_checkpoint_cb(args, prepare_payload=None):
 
     def cb(st, next_iter, losses):
         ckpt.save(args.checkpoint, st, next_iter, np.asarray(losses),
-                  prepare=prepare_payload)
+                  prepare=_payload_with_events(prepare_payload, supervisor,
+                                               prior_events))
     return cb
 
 
 def _save_final_checkpoint(args, state, iterations, losses,
-                           prepare_payload=None):
+                           prepare_payload=None, supervisor=None,
+                           prior_events=None):
     if not args.checkpoint:
         return
     import numpy as np
 
     from tsne_flink_tpu.utils import checkpoint as ckpt
     ckpt.save(args.checkpoint, state, iterations, np.asarray(losses),
-              prepare=prepare_payload)
+              prepare=_payload_with_events(prepare_payload, supervisor,
+                                           prior_events))
 
 
 def main(argv=None) -> int:
@@ -425,6 +469,12 @@ def _main(argv=None) -> int:
 
     theta_explicit = args.theta is not None
     args.theta = args.theta if theta_explicit else 0.25  # Tsne.scala:59
+
+    if args.faultPlan:
+        # recovery testing: install the fault plan before any instrumented
+        # site runs (same grammar/effect as $TSNE_FAULT_PLAN)
+        from tsne_flink_tpu.runtime import faults
+        faults.activate(args.faultPlan)
 
     multihost = (args.coordinator, args.numProcesses, args.processId)
     if any(v is not None for v in multihost):
@@ -587,6 +637,15 @@ def _main(argv=None) -> int:
     if args.auditPlan:
         audit_summary = _audit_gate(args, cfg, n, assembly, neighbors)
 
+    # ---- run supervisor (tsne_flink_tpu/runtime/): wraps prepare +
+    # optimize with the OOM degradation ladder (--onOom) and the
+    # divergence sentinel (--healthCheck); every recovery decision lands
+    # on its event list, which rides the checkpoint payload
+    from tsne_flink_tpu.runtime.supervisor import Supervisor
+    supervisor = Supervisor(_run_plan(args, cfg, n, assembly, neighbors),
+                            max_retries=args.maxRetries, on_oom=args.onOom,
+                            health_check=args.healthCheck)
+
     if args.spmd:
         # the whole job as ONE sharded program (SpmdPipeline); with
         # --checkpoint/--resume it switches to the segmented prepare+optimize
@@ -616,14 +675,18 @@ def _main(argv=None) -> int:
             return 0
         if args.profile:
             jax.profiler.start_trace(args.profile)
-        if args.resume or args.checkpoint:
+        if args.resume or args.checkpoint or args.healthCheck:
+            # --healthCheck needs the segmented form: the sentinel reads
+            # its flag (and rolls back) at segment boundaries
             start_iter, loss_carry, resume_state, _ = _load_resume(args,
                                                                    dtype)
             state, losses = pipe.run_checkpointable(
                 spmd_data, key, start_iter=start_iter, loss_carry=loss_carry,
                 resume_state=resume_state,
                 checkpoint_every=args.checkpointEvery,
-                checkpoint_cb=_make_checkpoint_cb(args))
+                checkpoint_cb=_make_checkpoint_cb(args),
+                health_check=args.healthCheck,
+                events=supervisor.events)
             y = state.y
             y.block_until_ready()
             if jax.process_count() > 1:
@@ -661,12 +724,21 @@ def _main(argv=None) -> int:
     # bench.py / tsne_embed via utils/artifacts.prepare and artifact-cached;
     # a v2 fat checkpoint skips it entirely
     start_iter, loss_carry, state, prep_payload = _load_resume(args, dtype)
+    prior_events = None
     if args.resume:
         # v2 checkpoints carry the original run's plan audit: detect a
         # resume whose config predicts a different footprint than the run
         # that wrote the checkpoint (backend/assembly/width drift)
         _check_resumed_audit(args, cfg, n, assembly, neighbors,
                              prep_payload)
+        # ... and the original run's recovery history, so this resumed
+        # run's checkpoints keep the whole degradation story
+        raw_events = (prep_payload or {}).get("events")
+        if raw_events:
+            try:
+                prior_events = json.loads(str(raw_events))
+            except ValueError:
+                prior_events = None
 
     prep_kwargs = dict(
         neighbors=neighbors, knn_method=args.knnMethod, metric=args.metric,
@@ -700,8 +772,13 @@ def _main(argv=None) -> int:
             print("# prepare: skipped (embedded in v2 checkpoint)",
                   file=sys.stderr)
     if jidx is None:
-        prep = art.prepare(cache=art_cache,
-                           knn_autotune=args.knnAutotune, **prep_kwargs)
+        # the supervisor relaunches only the failed stage on OOM: the
+        # artifact cache keeps the completed stages' outputs, and the
+        # ladder's overrides (knn_tiles / assembly) ride **ov
+        prep = supervisor.run_prepare(
+            lambda on_stage, **ov: art.prepare(
+                cache=art_cache, knn_autotune=args.knnAutotune,
+                on_stage=on_stage, **{**prep_kwargs, **ov}))
         jidx, jval = prep.jidx, prep.jval
         extra_edges, label = prep.extra_edges, prep.label
         affinity_fp = prep.affinity_fp
@@ -750,16 +827,19 @@ def _main(argv=None) -> int:
 
     if args.profile:
         jax.profiler.start_trace(args.profile)
-    state, losses = runner(state, jidx, jval, start_iter=start_iter,
-                           loss_carry=loss_carry,
-                           checkpoint_every=args.checkpointEvery,
-                           checkpoint_cb=_make_checkpoint_cb(args,
-                                                             save_payload),
-                           extra_edges=extra_edges)
+    state, losses = supervisor.run_optimize(
+        lambda c: (runner if c is cfg
+                   else shard_pipeline(c, n, n_devices=args.devices)),
+        cfg, state, jidx, jval, start_iter=start_iter,
+        loss_carry=loss_carry, checkpoint_every=args.checkpointEvery,
+        checkpoint_cb=_make_checkpoint_cb(args, save_payload, supervisor,
+                                          prior_events),
+        extra_edges=extra_edges)
     state.y.block_until_ready()
     if args.profile:
         jax.profiler.stop_trace()
-    _save_final_checkpoint(args, state, cfg.iterations, losses, save_payload)
+    _save_final_checkpoint(args, state, cfg.iterations, losses, save_payload,
+                           supervisor, prior_events)
 
     tio.write_embedding(args.output, ids, np.asarray(state.y[:n]))
     tio.write_loss(args.loss, np.asarray(losses))

@@ -90,6 +90,30 @@ _declare("TSNE_KNN_AUTOTUNE", "bool", False,
          "before the kNN stage (the CLI's --knnAutotune; recall-invariant "
          "by construction).")
 
+# ---- runtime resilience (tsne_flink_tpu/runtime/) --------------------------
+_declare("TSNE_FAULT_PLAN", "str", None,
+         "Deterministic fault-injection plan (runtime/faults.py), "
+         "comma-separated kind@site[:trigger] clauses — e.g. "
+         "'oom@knn:1,kill@optimize:seg2,corrupt@checkpoint'. Kinds: oom "
+         "(synthetic RESOURCE_EXHAUSTED), kill (SIGKILL at a segment "
+         "boundary), corrupt (bit-flip the just-written checkpoint), nan "
+         "(poison a segment's input state). Testing only; unset in "
+         "production.")
+_declare("TSNE_ON_OOM", "str", "ladder",
+         "Bench default for the supervisor's device-OOM policy: 'ladder' "
+         "degrades the plan (runtime/ladder.py: shrink kNN tiles -> blocks "
+         "assembly -> demote repulsion) and relaunches the failed stage; "
+         "'fail' propagates the OOM. The CLI's --onOom overrides per run.",
+         choices=("ladder", "fail"))
+_declare("TSNE_MAX_RETRIES", "int", 2,
+         "Bench default for the supervisor's per-phase ladder relaunch "
+         "bound (the CLI's --maxRetries).")
+_declare("TSNE_HEALTH_CHECK", "bool", False,
+         "Bench default for the divergence sentinel (the CLI's "
+         "--healthCheck): per-segment on-device finite-check on (Y, gains, "
+         "KL); a non-finite segment rolls back to the last good state and "
+         "retries with halved eta and a fresh momentum buffer.")
+
 # ---- caches ----------------------------------------------------------------
 _declare("TSNE_ARTIFACTS", "bool", True,
          "Prepare-artifact cache (utils/artifacts.py) on/off for bench/CLI "

@@ -17,9 +17,30 @@
 
 from __future__ import annotations
 
+import os
+import tempfile
+
 import numpy as np
 
 from tsne_flink_tpu.utils import native as _native
+
+
+def atomic_write(path: str, write_fn) -> None:
+    """tmp + rename write: ``write_fn(tmp_path)`` produces the content,
+    which is then atomically renamed into place — a kill mid-write can
+    never leave a truncated embedding/loss/record file for downstream
+    harvesting to choke on (the same contract utils/checkpoint.py and
+    utils/artifacts.py already keep for their files)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".out.tmp")
+    os.close(fd)
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _load_coo(path: str) -> np.ndarray:
@@ -76,16 +97,22 @@ def read_distance_matrix(path: str):
 
 
 def write_embedding(path: str, ids: np.ndarray, y: np.ndarray) -> None:
-    if _native.write_embedding(path, ids, y):
-        return
-    n, m = y.shape
-    with open(path, "w") as f:
-        for i in range(n):
-            f.write(str(int(ids[i])) + "," +
-                    ",".join(repr(float(v)) for v in y[i]) + "\n")
+    def emit(tmp):
+        if _native.write_embedding(tmp, ids, y):
+            return
+        n, m = y.shape
+        with open(tmp, "w") as f:
+            for i in range(n):
+                f.write(str(int(ids[i])) + "," +
+                        ",".join(repr(float(v)) for v in y[i]) + "\n")
+
+    atomic_write(path, emit)
 
 
 def write_loss(path: str, losses: np.ndarray, every: int = 10) -> None:
-    with open(path, "w") as f:
-        for t, v in enumerate(np.asarray(losses)):
-            f.write(f"{(t + 1) * every},{float(v)!r}\n")
+    def emit(tmp):
+        with open(tmp, "w") as f:
+            for t, v in enumerate(np.asarray(losses)):
+                f.write(f"{(t + 1) * every},{float(v)!r}\n")
+
+    atomic_write(path, emit)
