@@ -76,9 +76,9 @@ DATA_SEED = 0
 #: closed at both ends.
 RECORD_BASE_KEYS = (
     "metric", "unit", "backend", "devices", "n", "iterations", "repulsion",
-    "theta", "knn_rounds", "knn_refine", "data", "data_seed", "peak_flops",
-    "peak_flops_basis", "assembly", "cache", "matmul_dtype", "knn_tiles",
-    "audit", "degradations",
+    "theta", "knn_method", "knn_rounds", "knn_refine", "data", "data_seed",
+    "peak_flops", "peak_flops_basis", "assembly", "cache", "matmul_dtype",
+    "knn_tiles", "audit", "degradations", "aot_cache",
 )
 
 
@@ -244,7 +244,6 @@ def main():
 
     from tsne_flink_tpu.models.tsne import (LOSS_EVERY, TsneConfig,
                                             init_working_set)
-    from tsne_flink_tpu.ops.knn import pick_knn_refine, pick_knn_rounds
     from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
@@ -320,9 +319,20 @@ def main():
                      repulsion=repulsion, attraction=attraction,
                      row_chunk=4096)
     k = 90  # 3 * perplexity (Tsne.scala:55)
-    # the same auto recall policy the CLI runs: Z-order seed + NN-descent
-    rounds = pick_knn_rounds(n)
-    refine = pick_knn_refine(n, d_in)
+    # the same auto kNN policy the CLI runs, resolved up front so the
+    # record, the FLOP model and the fingerprint all key the method that
+    # actually runs (round 7: pick_knn_method routes the 60k CPU/TPU
+    # shapes to the exact sweep — ~100 s at recall 1.0 on this host vs the
+    # hybrid's 305.6 s at 0.9393 — and back to the hybrid where N² wins)
+    from tsne_flink_tpu.utils.artifacts import resolve_knn_plan
+    knn_method, rounds, refine = resolve_knn_plan(n, d_in, "auto",
+                                                  None, None, k=k)
+
+    # AOT executable persistence (utils/aot.py): plan-keyed serialized
+    # executables for the kNN stage + optimize segments, plus the compile
+    # meter that splits measured compile seconds out of every stage time
+    from tsne_flink_tpu.utils import aot
+    aot.install_compile_meter()
 
     # ---- analytic FLOP model + MFU (VERDICT r2 weak #2): computed UP FRONT
     # so every partial record can scale the unmeasured remainder by the
@@ -330,14 +340,19 @@ def main():
     # wall-clock lands, on whatever backend actually ran
     from tsne_flink_tpu.ops.knn_tiles import pick_knn_tiles
     from tsne_flink_tpu.utils.flops import (
-        affinity_flops, knn_substage_flops, optimize_flops, peak_flops)
+        affinity_flops, knn_flops, knn_substage_flops, optimize_flops,
+        peak_flops)
     backend = jax.default_backend()
     # the tile plan the prepare stage will resolve (same model; autotune,
     # when enabled, overrides and the record is updated after prepare)
     tile_plan = pick_knn_tiles(n, d_in, k, backend)
-    f_knn_sub = knn_substage_flops(n, d_in, k, rounds=rounds,
-                                   block=tile_plan.block,
-                                   refine_rounds=refine)
+    if knn_method == "project":
+        f_knn_sub = knn_substage_flops(n, d_in, k, rounds=rounds,
+                                       block=tile_plan.block,
+                                       refine_rounds=refine)
+    else:
+        # exact sweep: one substage, mirroring the dispatch's on_substage
+        f_knn_sub = {"exact": knn_flops(n, d_in, k, knn_method)}
     f_knn = float(sum(f_knn_sub.values()))
     f_aff = affinity_flops(n, k)
     kind = jax.devices()[0].device_kind if backend == "tpu" else ""
@@ -357,7 +372,8 @@ def main():
     from tsne_flink_tpu.analysis.audit.compile import plan_compile_count
     from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
     _plan = PlanConfig(n=n, d=d_in, k=k, backend=backend,
-                       iterations=iters, knn_rounds=rounds,
+                       iterations=iters, knn_method=knn_method,
+                       knn_rounds=rounds,
                        knn_refine=refine, repulsion=repulsion,
                        theta=theta, assembly=assembly,
                        attraction=attraction, row_chunk=cfg.row_chunk,
@@ -386,7 +402,8 @@ def main():
         "metric": "mnist60k_embed_seconds", "unit": "s",
         "backend": backend, "devices": jax.device_count(),
         "n": n, "iterations": iters, "repulsion": repulsion,
-        "theta": cfg.theta, "knn_rounds": rounds, "knn_refine": refine,
+        "theta": cfg.theta, "knn_method": knn_method,
+        "knn_rounds": rounds, "knn_refine": refine,
         "data": DATA_PROVENANCE, "data_seed": DATA_SEED,
         "peak_flops": peak, "peak_flops_basis": basis,
         # self-describing records (ADVICE r5 #1): the REQUESTED assembly
@@ -406,6 +423,10 @@ def main():
         # the live list at every emission, so a mid-run demotion is
         # visible from the first record that follows it
         "degradations": [],
+        # AOT executable cache state (utils/aot.py): off | cold | warm |
+        # mixed — overwritten at every emission, so a cold and a warm-AOT
+        # process emit DISTINCT records for the same workload
+        "aot_cache": aot.cache_label(),
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
@@ -414,13 +435,32 @@ def main():
         base["tunnel_down"] = True
         base["last_tpu_record"] = _latest_tpu_record()
 
+    # measured compile attribution (the compile meter in utils/aot.py):
+    # per-stage backend-compile seconds/counts, diffed around each stage so
+    # wall times can be read net of compilation — the measured-time twin of
+    # the compile-audit's static compile_count
+    compile_s: dict = {}
+    compile_n: dict = {}
+    _cm = {"last": aot.compile_snapshot()}
+
+    def compile_mark(stage):
+        now = aot.compile_snapshot()
+        compile_s[stage] = round(
+            compile_s.get(stage, 0.0)
+            + now["seconds"] - _cm["last"]["seconds"], 3)
+        compile_n[stage] = (compile_n.get(stage, 0)
+                            + now["count"] - _cm["last"]["count"])
+        _cm["last"] = now
+
     def emit_partial(measured_s, est_total_s, stages, note):
         est = max(float(est_total_s), float(measured_s))
         _emit({**base, "value": round(est, 3),
                "vs_baseline": round(10.0 / est, 3), "partial": True,
                "measured_seconds": round(float(measured_s), 3),
                "stages": {k_: round(v, 3) for k_, v in stages.items()},
+               "compile_seconds": dict(compile_s),
                "degradations": sup.degradations,
+               "aot_cache": aot.cache_label(),
                "estimate_basis": note})
 
     x = jnp.asarray(x_np)
@@ -436,6 +476,7 @@ def main():
     # A cache-loaded stage contributes ZERO FLOPs to every rate/MFU figure
     # — a warm run must never claim the arithmetic it skipped.
     def on_stage(stage, secs, cache_state):
+        compile_mark(stage)
         if stage != "knn":
             return
         f_knn_m = 0.0 if cache_state == "warm" else f_knn
@@ -457,13 +498,14 @@ def main():
     # "degradations" then report what actually ran
     prep = sup.run_prepare(
         lambda on_stage, **ov: prepare_stage(
-            x, neighbors=k, knn_method="project",
+            x, neighbors=k, knn_method=knn_method,
             knn_rounds=rounds, knn_refine=refine,
             key=jax.random.key(0), perplexity=cfg.perplexity,
             cache=art_cache, on_stage=on_stage,
             knn_autotune=env_bool("TSNE_KNN_AUTOTUNE"),
             **{"assembly": assembly, **ov}),
         on_stage=on_stage)
+    compile_mark("affinities")  # anything after the knn mark is affinity
     t_knn, t_aff = prep.knn_seconds, prep.affinity_seconds
     jidx, jval, extra = prep.jidx, prep.jval, prep.extra_edges
     label = prep.label
@@ -476,7 +518,7 @@ def main():
     f_aff_run = 0.0 if prep.affinity_cache == "warm" else f_aff
 
     state = init_working_set(jax.random.key(0), n, 2, jnp.float32)
-    runner = ShardedOptimizer(cfg, n)
+    runner = ShardedOptimizer(cfg, n, aot_plan=_plan)
     s = int(jidx.shape[1])  # true symmetrized row width the optimizer runs
     # ask the optimizer which attraction layout it actually launches so the
     # FLOP model counts the launched pairs (utils/flops.py) — single- AND
@@ -539,7 +581,8 @@ def main():
         # relaunches from the last segment boundary; _DeadlineStop (not an
         # OOM) passes straight through to the window-proofing handler
         state, losses = sup.run_optimize(
-            lambda c: runner if c is cfg else ShardedOptimizer(c, n),
+            lambda c: (runner if c is cfg
+                       else ShardedOptimizer(c, n, aot_plan=_plan)),
             cfg, state, jidx, jval, checkpoint_every=seg,
             checkpoint_cb=cb, extra_edges=extra)
         it_done = iters
@@ -550,6 +593,7 @@ def main():
               f"{iters} iters; extrapolating", file=sys.stderr)
     jax.block_until_ready(state.y)
     t_opt = time.time() - t2
+    compile_mark("optimize")
 
     complete = it_done == iters
     total = (t_knn + t_aff + t_opt if complete
@@ -602,7 +646,13 @@ def main():
            "sym_width": s, "attraction": layout, "attraction_pairs": pairs,
            # supervisor history: ladder steps + every recovery decision
            # (oom / degrade / relaunch / sentinel-rollback events)
-           "degradations": sup.degradations, "runtime_events": sup.events}
+           "degradations": sup.degradations, "runtime_events": sup.events,
+           # measured compile split (utils/aot.py compile meter): per-stage
+           # backend-compile seconds/counts — a warm-AOT process shows
+           # compile_seconds ~ 0 while "stages" wall times stay honest
+           "compile_seconds": dict(compile_s),
+           "compile_counts": dict(compile_n),
+           "aot_cache": aot.cache_label(), "aot": aot.stats()}
     if not complete:
         rec.update(extrapolated=True, iterations_run=it_done,
                    measured_seconds=round(measured_s, 3))

@@ -114,6 +114,19 @@ def main(argv=None):
         n, d, k, rounds=rounds, block=tiles.block, refine_rounds=cycles)
     emit("model", {"flops": rec["model_flops"], "bytes": rec["model_bytes"]})
 
+    # ---- fused-kernel A/B: the XLA exact chunk vs the Pallas fused sweep
+    # (ops/knn_pallas).  On TPU this is the real Mosaic kernel; elsewhere
+    # it runs in interpret mode — attribution of the kernel's algorithm,
+    # not a hardware claim — so off-TPU it only runs at the smoke shape.
+    if args.smoke or backend == "tpu":
+        rec["kernel_ab"] = kernel_ab(jax, x, k, tiles, args.reps, emit)
+
+    # ---- AOT executable persistence (utils/aot.py) warm/cold split: the
+    # same entry function compiled + serialized cold, then warm-loaded —
+    # the per-process compile tax the plan-keyed cache deletes.
+    if args.smoke:
+        rec["aot"] = aot_split(jax, x, k, emit)
+
     if not args.no_fine and cycles > 0:
         rec["fine"] = fine_stages(jax, jnp, lax, K, x, idx, dist, k, tiles,
                                   args.reps, emit)
@@ -127,6 +140,66 @@ def main(argv=None):
     print(json.dumps({"stage": "written", "path": os.path.relpath(out)}),
           flush=True)
     return 0
+
+
+def kernel_ab(jax, x, k, tiles, reps, emit):
+    """Timed A/B of the exact kNN kernels at this shape: the chunked XLA
+    pairwise+top_k path against the fused Pallas distance/top-k sweep."""
+    import time as _time
+
+    from tsne_flink_tpu.ops.knn import knn_bruteforce
+    from tsne_flink_tpu.ops.knn_pallas import fused_knn
+
+    def timed(f):
+        out = jax.block_until_ready(f())  # compile
+        best = float("inf")
+        for _ in range(max(1, reps)):
+            t0 = _time.time()
+            out = jax.block_until_ready(f())
+            best = min(best, _time.time() - t0)
+        return best, out
+
+    t_xla, (xi, _) = timed(lambda: knn_bruteforce(x, k, tiles=tiles,
+                                                  kernel="xla"))
+    on_tpu = jax.default_backend() == "tpu"
+    t_fused, (fi, _) = timed(lambda: fused_knn(
+        x, k, interpret=not on_tpu, tiles=tiles))
+    agree = bool((xi == fi).all())
+    ab = {"exact_xla": round(t_xla, 3),
+          "exact_fused": round(t_fused, 3),
+          "fused_mode": "mosaic" if on_tpu else "interpret",
+          "indices_agree": agree}
+    emit("kernel_ab", ab)
+    return ab
+
+
+def aot_split(jax, x, k, emit):
+    """Cold-compile vs warm-load seconds for one AOT-persisted kNN entry
+    executable (utils/aot.wrap into a throwaway cache dir)."""
+    import tempfile
+    import time as _time
+
+    from tsne_flink_tpu.ops.knn import knn_bruteforce
+    from tsne_flink_tpu.utils import aot
+
+    root = tempfile.mkdtemp(prefix="tsne-aot-profile-")
+    jf = jax.jit(lambda xx: knn_bruteforce(xx, k, kernel="xla"))
+    key = {"profile": "aot-split", "n": int(x.shape[0]),
+           "d": int(x.shape[1]), "k": k}
+    w_cold = aot._PersistentFn(jf, key, "profile-knn", root=root)
+    t0 = _time.time()
+    jax.block_until_ready(w_cold(x))
+    cold_s = _time.time() - t0
+    w_warm = aot._PersistentFn(jf, key, "profile-knn", root=root)
+    t0 = _time.time()
+    jax.block_until_ready(w_warm(x))
+    warm_s = _time.time() - t0
+    out = {"cold_seconds": round(cold_s, 3),
+           "warm_seconds": round(warm_s, 3),
+           "cold_state": w_cold.cache_state,
+           "warm_state": w_warm.cache_state}
+    emit("aot_split", out)
+    return out
 
 
 def fine_stages(jax, jnp, lax, K, x, idx, dist, k, tiles, reps, emit):

@@ -24,6 +24,7 @@ _FAST_MODULES = {
     "test_flops", "test_edge_cases", "test_native_io", "test_pallas",
     "test_checkpoint", "test_cli", "test_quality_gate", "test_cache",
     "test_artifacts", "test_knn_tiles", "test_audit", "test_runtime",
+    "test_knn_kernel", "test_aot",
 }
 
 
@@ -41,6 +42,11 @@ def pytest_collection_modifyitems(config, items):
 # run would mask cold-path bugs).  Tests that exercise the cache pass an
 # explicit --cacheDir / ArtifactCache(tmp_path), which overrides this.
 os.environ.setdefault("TSNE_ARTIFACTS", "0")
+# same hermeticity for the AOT executable cache (utils/aot.py): a warm
+# executable from a previous test run would mask cold-path bugs, and tests
+# must not write the repo-local .tsne_aot.  AOT tests opt in with their own
+# tmp roots (test_aot.py).
+os.environ.setdefault("TSNE_AOT_CACHE", "0")
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -1,0 +1,169 @@
+"""AOT executable persistence (utils/aot.py) + compilation-cache pins.
+
+The round-trip contract: an entry function compiled in one process is
+serialized keyed on (plan identity, argument layout, jax version, backend,
+host signature); a second process deserializes it, runs it with ZERO
+lower/compile work through the AOT layer, and produces bit-identical
+output.  Damaged or foreign entries are silently misses, never crashes.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tsne_flink_tpu.utils import aot
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def test_wrap_cold_then_warm_bit_identical(tmp_path):
+    f = jax.jit(lambda x: jnp.cumsum(x * 3.5) - x)
+    x = jnp.arange(64, dtype=jnp.float32)
+    w1 = aot._PersistentFn(f, {"plan.n": 64}, "unit", root=str(tmp_path))
+    r1 = np.asarray(w1(x))
+    assert w1.cache_state == "cold"
+    w2 = aot._PersistentFn(f, {"plan.n": 64}, "unit", root=str(tmp_path))
+    r2 = np.asarray(w2(x))
+    assert w2.cache_state == "warm"
+    np.testing.assert_array_equal(r1, r2)
+    # repeated calls reuse the loaded executable (no re-probe)
+    np.testing.assert_array_equal(np.asarray(w2(x)), r2)
+
+
+def test_wrap_key_isolation(tmp_path):
+    """A different plan identity or argument layout must never hit."""
+    f = jax.jit(lambda x: x * 2)
+    x8 = jnp.arange(8, dtype=jnp.float32)
+    w = aot._PersistentFn(f, {"plan.n": 8}, "unit", root=str(tmp_path))
+    w(x8)
+    other_plan = aot._PersistentFn(f, {"plan.n": 9}, "unit",
+                                   root=str(tmp_path))
+    other_plan(x8)
+    assert other_plan.cache_state == "cold"
+    other_shape = aot._PersistentFn(f, {"plan.n": 8}, "unit",
+                                    root=str(tmp_path))
+    other_shape(jnp.arange(16, dtype=jnp.float32))
+    assert other_shape.cache_state == "cold"
+
+
+def test_corrupt_entry_is_a_miss_and_replaced(tmp_path):
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.arange(8, dtype=jnp.float32)
+    w = aot._PersistentFn(f, {}, "unit", root=str(tmp_path))
+    w(x)
+    (entry,) = [p for p in os.listdir(tmp_path) if p.endswith(".aot")]
+    path = os.path.join(str(tmp_path), entry)
+    with open(path, "wb") as fh:
+        fh.write(b"garbage")
+    w2 = aot._PersistentFn(f, {}, "unit", root=str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(w2(x)),
+                                  np.arange(8, dtype=np.float32) + 1)
+    assert w2.cache_state == "cold"  # recompiled and re-saved
+    with open(path, "rb") as fh:
+        assert pickle.load(fh)["magic"] == aot.MAGIC
+
+
+def test_foreign_entry_key_mismatch_rejected(tmp_path):
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.arange(8, dtype=jnp.float32)
+    w = aot._PersistentFn(f, {}, "unit", root=str(tmp_path))
+    w(x)
+    (entry,) = os.listdir(tmp_path)
+    path = os.path.join(str(tmp_path), entry)
+    with open(path, "rb") as fh:
+        payload = pickle.load(fh)
+    payload["key"] = "0" * 32  # a foreign host/plan's key under our name
+    with open(path, "wb") as fh:
+        pickle.dump(payload, fh)
+    w2 = aot._PersistentFn(f, {}, "unit", root=str(tmp_path))
+    w2(x)
+    assert w2.cache_state == "cold"
+
+
+def test_wrap_respects_enablement(monkeypatch):
+    f = jax.jit(lambda x: x)
+    monkeypatch.setenv("TSNE_AOT_CACHE", "0")
+    aot.set_enabled(None)
+    assert aot.wrap(f, {}, "unit") is f
+    assert aot.cache_label() == "off"
+    aot.set_enabled(True)
+    try:
+        assert isinstance(aot.wrap(f, {}, "unit"), aot._PersistentFn)
+    finally:
+        aot.set_enabled(None)
+
+
+def test_plan_key_parts_cover_every_plan_field():
+    from tsne_flink_tpu.analysis.audit.plan import bench_plan
+    plan = bench_plan(1000, 32, backend="cpu")
+    parts = aot.plan_key_parts(plan)
+    for field in ("n", "d", "k", "backend", "dtype", "knn_method",
+                  "repulsion", "assembly", "iterations"):
+        assert f"plan.{field}" in parts
+
+
+def test_compilation_cache_threshold_pinned_at_zero(tmp_path, monkeypatch):
+    """Satellite pin (round 7): small per-chunk kernels compile in under a
+    second and fell below jax's default 1.0 s persistence threshold — every
+    process silently recompiled them.  enable_compilation_cache must pin
+    the threshold to 0.0 so every executable persists."""
+    monkeypatch.setenv("TSNE_TPU_CACHE_DIR", str(tmp_path))
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    enable_compilation_cache()
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+
+
+_ROUNDTRIP = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+from tsne_flink_tpu.utils import aot
+aot.install_compile_meter()
+from tsne_flink_tpu.utils.artifacts import prepare
+from bench import make_data
+x = jnp.asarray(make_data(1500, 48))
+prep = prepare(x, neighbors=20, knn_method="bruteforce",
+               metric="sqeuclidean", key=jax.random.key(0),
+               perplexity=10.0, cache=None)
+import hashlib
+sha = hashlib.sha256(np.asarray(prep.idx).tobytes()
+                     + np.asarray(prep.dist).tobytes()).hexdigest()
+print(json.dumps({"sha": sha, "aot": aot.stats(),
+                  "meter": aot.compile_snapshot()}))
+"""
+
+
+def test_aot_roundtrip_across_processes(tmp_path):
+    """Cold process compiles + serializes the kNN entry executable; a warm
+    process loads it: zero lower/compile seconds through the AOT layer and
+    a bit-identical graph."""
+    env = dict(os.environ, TSNE_AOT_DIR=str(tmp_path), TSNE_AOT_CACHE="1",
+               TSNE_ARTIFACTS="0", JAX_PLATFORMS="cpu",
+               # isolate from the repo's persistent XLA cache so the warm
+               # win measured here is the AOT layer's alone
+               TSNE_TPU_CACHE_DIR=str(tmp_path / "xla"))
+    runs = []
+    for _ in range(2):
+        out = subprocess.run([sys.executable, "-c",
+                              _ROUNDTRIP % {"repo": REPO}],
+                             capture_output=True, text=True, env=env,
+                             cwd=REPO, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        runs.append(json.loads(out.stdout.strip().splitlines()[-1]))
+    cold, warm = runs
+    assert cold["sha"] == warm["sha"]                 # bit-identical graph
+    assert cold["aot"]["misses"] >= 1
+    assert cold["aot"]["compile_seconds"] > 0
+    assert warm["aot"]["hits"] >= 1
+    assert warm["aot"]["misses"] == 0                 # zero new compiles
+    assert warm["aot"]["compile_seconds"] == 0.0

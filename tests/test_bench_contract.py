@@ -99,10 +99,15 @@ def test_final_record_carries_knn_substages_and_tile_plan():
     assert tiles["source"] in ("model", "autotune")
     subs = final["stages"]["knn_substages"]
     assert subs and all(v >= 0 for v in subs.values())
-    # at n=800 the auto plan is a pure Z-order seed (refine=0)
-    assert "zorder_seed" in subs
+    # round 7: the auto kNN METHOD routes n=800 on CPU to the exact sweep
+    # (pick_knn_method), recorded as the one "exact" substage
+    assert final["knn_method"] == "bruteforce"
+    assert "exact" in subs
     fsub = final["stage_flops"]["knn_substages"]
-    assert fsub["band_rerank"] > 0  # cold run: substage FLOPs are real
+    assert fsub["exact"] > 0  # cold run: substage FLOPs are real
+    # round 7: compile split + AOT cache label ride every record
+    assert final["aot_cache"] in ("off", "cold", "warm", "mixed")
+    assert "knn" in final["compile_seconds"]
     # substage FLOPs sum to the stage total the MFU is computed from
     assert abs(sum(fsub.values()) - final["stage_flops"]["knn"]) <= max(
         1.0, 1e-6 * final["stage_flops"]["knn"])

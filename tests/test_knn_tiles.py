@@ -33,8 +33,9 @@ def test_plan_fields_and_record():
     assert isinstance(plan, KnnTilePlan)
     rec = plan.as_record()
     assert set(rec) == {"row_chunk", "col_block", "block", "refine_chunk",
-                        "source"}
+                        "source", "kernel", "pallas_rows", "pallas_cols"}
     assert rec["source"] == "model"
+    assert rec["kernel"] == "xla"  # CPU backend: the XLA tile path
     json.dumps(rec)  # bench records embed it — must be JSON-safe
 
 

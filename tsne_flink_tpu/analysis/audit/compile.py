@@ -72,8 +72,8 @@ def knn_stage_programs(plan: PlanConfig) -> int:
     (utils/artifacts.prepare runs the hybrid DECOMPOSED): seed + cycle +
     merge + refine for the refined hybrid — constant in the cycle count —
     else the one fused program."""
-    if plan.knn_method != "project":
-        return 1
+    if plan.resolved_method() != "project":
+        return 1  # one fused exact program (XLA tiles or the Pallas sweep)
     _rounds, refine = plan.resolved_knn()
     return 4 if refine > 0 else 1
 

@@ -242,6 +242,18 @@ contract("ops.repulsion_pallas._run",
          "tsne_flink_tpu/ops/repulsion_pallas.py", ("float32", "float32"),
          trace=False)
 
+# ---- ops/knn_pallas.py ------------------------------------------------------
+# Fused distance/top-k kNN kernel + the fused refine candidate scorer:
+# declared-only like the repulsion kernel (runtime-probed by
+# mosaic_knn_supported; the XLA knn paths above carry the traced contract).
+# Output order of the fused sweep: (idx int32, dist) like knn_bruteforce.
+contract("ops.knn_pallas._run_fused",
+         "tsne_flink_tpu/ops/knn_pallas.py", ("int32", "float32"),
+         trace=False)
+contract("ops.knn_pallas._run_cand",
+         "tsne_flink_tpu/ops/knn_pallas.py", ("float32",),
+         trace=False)
+
 
 # ---- models/tsne.py ---------------------------------------------------------
 

@@ -49,19 +49,34 @@ def _gib(b: float) -> float:
 
 def _knn_stage(plan: PlanConfig) -> dict:
     """Live-set candidates of the kNN stage; the stage peak is their max."""
-    from tsne_flink_tpu.ops.knn_tiles import (pick_knn_tiles,
+    from tsne_flink_tpu.ops.knn_tiles import (fused_tile_bytes,
+                                              pick_knn_tiles,
                                               refine_chunk_bytes)
     n, d, k, isz = plan.n, plan.d, plan.k, plan.itemsize
-    x = float(n * d * isz) if plan.knn_method != "precomputed" else 0.0
+    method = plan.resolved_method()
+    x = float(n * d * isz) if method != "precomputed" else 0.0
     graph = float(n * k * (4 + isz))          # idx int32 + dist
     terms: dict[str, float] = {"input": x, "graph": graph}
-    if plan.knn_method in ("bruteforce", "partition"):
+    if method in ("bruteforce", "partition"):
         tiles = pick_knn_tiles(n, d, k, plan.backend)
+        terms["kernel"] = tiles.kernel
+        if tiles.kernel.startswith("pallas"):
+            # fused Pallas sweep (ops/knn_pallas): the only HBM-resident
+            # transients are the [N, KPAD] top-k accumulator pair — the
+            # distance tiles live in VMEM (fused_tile_bytes budgets them
+            # against PALLAS_VMEM_BUDGET, not HBM)
+            kpad = max(128, -(-k // 128) * 128)
+            terms["exact_acc"] = float(n * kpad * (4 + isz))
+            terms["exact_tile"] = PIPELINE_FACTOR * fused_tile_bytes(
+                tiles.pallas_rows, tiles.pallas_cols, d, k, itemsize=isz)
+            terms["peak"] = (x + graph + terms["exact_acc"]
+                             + terms["exact_tile"])
+            return terms
         # one [row_chunk, n] distance tile (+ top-k scratch), pipelined
         terms["exact_tile"] = PIPELINE_FACTOR * tiles.row_chunk * n * isz
         terms["peak"] = x + graph + terms["exact_tile"]
         return terms
-    if plan.knn_method == "precomputed":
+    if method == "precomputed":
         terms["peak"] = graph
         return terms
 

@@ -82,12 +82,23 @@ class PlanConfig:
     def itemsize(self) -> int:
         return {"float32": 4, "float64": 8, "bfloat16": 2}[self.dtype]
 
+    def resolved_method(self) -> str:
+        """The kNN method the dispatch will actually run: ``auto`` goes
+        through ``ops/knn.pick_knn_method`` (the round-7 exact-vs-hybrid
+        cost model) exactly as ``utils/artifacts.resolve_knn_plan``."""
+        method, _, _ = self._resolved_plan()
+        return method
+
     def resolved_knn(self) -> tuple[int, int]:
         """(rounds, refine) exactly as utils/artifacts.resolve_knn_plan."""
-        from tsne_flink_tpu.utils.artifacts import resolve_knn_plan
-        rounds, refine = resolve_knn_plan(
-            self.n, self.d, self.knn_method, self.knn_rounds, self.knn_refine)
+        _, rounds, refine = self._resolved_plan()
         return (rounds or 0, refine or 0)
+
+    def _resolved_plan(self):
+        from tsne_flink_tpu.utils.artifacts import resolve_knn_plan
+        return resolve_knn_plan(self.n, self.d, self.knn_method,
+                                self.knn_rounds, self.knn_refine, k=self.k,
+                                backend=self.backend)
 
     def resolved_repulsion(self) -> str:
         """The backend the optimizer will actually dispatch."""

@@ -577,6 +577,9 @@ CLI_ONLY_FLAGS = {
     "input", "output", "dimension", "inputDistanceMatrix", "executionPlan",
     "loss", "checkpoint", "checkpointEvery", "resume", "fatCheckpoint",
     "noCache", "profile", "coordinator", "numProcesses", "processId",
+    # negation alias of --aotCache (whose kwarg twin is aot_cache): one
+    # tri-state kwarg covers both spellings on the estimator side
+    "noAotCache",
     # launch-control gate, not a model hyper-parameter: the estimator runs
     # in-process where the caller can invoke the audit API directly
     "auditPlan",

@@ -42,7 +42,8 @@ class TSNE:
                  affinity_assembly: str | None = None,
                  cache_dir: str | None = None,
                  max_retries: int = 2, on_oom: str = "ladder",
-                 health_check: bool = False):
+                 health_check: bool = False,
+                 aot_cache: bool | None = None):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -129,6 +130,11 @@ class TSNE:
         self.max_retries = max_retries
         self.on_oom = on_oom
         self.health_check = health_check
+        # tri-state AOT executable cache override (the CLI's
+        # --aotCache/--noAotCache): True/False force utils/aot.py on/off
+        # for this fit, None defers to $TSNE_AOT_CACHE.  A LIBRARY caller
+        # who wants disk persistence opts in explicitly, like cache_dir.
+        self.aot_cache = aot_cache
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
@@ -196,6 +202,17 @@ class TSNE:
         return ArtifactCache(self.cache_dir)
 
     def _fit(self, x) -> "TSNE":
+        from tsne_flink_tpu.utils import aot
+        if self.aot_cache is not None:
+            prev = aot.enabled_override()
+            aot.set_enabled(self.aot_cache)
+            try:
+                return self._fit_inner(x)
+            finally:
+                aot.set_enabled(prev)
+        return self._fit_inner(x)
+
+    def _fit_inner(self, x) -> "TSNE":
         import jax
 
         cfg = self._config(x.shape[0])
@@ -206,7 +223,14 @@ class TSNE:
             k = (self.neighbors if self.neighbors is not None
                  else 3 * int(cfg.perplexity))
             cache = self._artifact_cache()
-            pipe = SpmdPipeline(cfg, n, d, k, knn_method=self.knn_method,
+            knn_method = self.knn_method
+            if knn_method == "auto":
+                # SpmdPipeline takes a concrete method; resolve the auto
+                # policy exactly like prepare would
+                from tsne_flink_tpu.utils.artifacts import resolve_knn_plan
+                knn_method, _, _ = resolve_knn_plan(
+                    n, d, "auto", self.knn_iterations, self.knn_refine, k=k)
+            pipe = SpmdPipeline(cfg, n, d, k, knn_method=knn_method,
                                 knn_rounds=self.knn_iterations,
                                 knn_refine=self.knn_refine,
                                 sym_width=self.sym_width,

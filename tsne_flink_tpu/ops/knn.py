@@ -139,15 +139,94 @@ def pick_knn_refine(n: int, d: int | None = None) -> int:
     return cycles
 
 
+def _kernel_of(tiles, kernel: str | None) -> str:
+    """Resolve the distance/top-k kernel label for an exact-tile call: the
+    explicit argument wins, else the tile plan's resolved policy
+    (``ops/knn_tiles.pick_knn_tiles`` via ``pick_knn_kernel``)."""
+    if kernel is not None:
+        return kernel
+    return getattr(tiles, "kernel", "xla") if tiles is not None else "xla"
+
+
+#: effective kNN-stage throughputs (FLOP/s) :func:`pick_knn_method` weighs
+#: the two plans with.  These are measured WALL-CLOCK efficiencies, not MFU
+#: aspirations, and they are deliberately coarse — the decision they feed
+#: only has to be right about a ~3x gap, not a 10% one.  CPU basis
+#: (round 7, this host, 60k x 784 k=90): the exact sweep's [1024, 60000]
+#: chunk ran 96.3 GFLOP in 1.66 s ≈ 58 GF/s (matmul-dominated), while the
+#: hybrid plan's 2.1 TFLOP took 299.4 s ≈ 7 GF/s (results/
+#: profile_knn_cpu.json — its wall clock is dominated by gather/sort work
+#: the FLOP model barely counts, which is exactly why the exact sweep wins
+#: at bench scale despite ~2.7x the FLOPs).  TPU: the fused kernel keeps
+#: the sweep MXU-bound (estimate ~5% of a v5e's 394 TF/s bf16 peak after
+#: the in-kernel top-k merge), against the hybrid's measured ~0.04% MFU
+#: launch-bound profile (VERDICT r5) credited a generous 25x improvement.
+KNN_EXACT_EFF = {"cpu": 55e9, "tpu": 2.0e13}
+KNN_HYBRID_EFF = {"cpu": 7e9, "tpu": 1.0e12}
+
+#: the exact XLA path materializes a [row_chunk, N] distance block per
+#: chunk; past this transient the auto policy prefers the partition
+#: schedule, whose streaming merge bounds the width (the Pallas kernel
+#: never materializes the block, so the cap only matters off-TPU).
+EXACT_TILE_BYTES_MAX = 1 << 30
+
+
+def pick_knn_method(n: int, d: int, k: int,
+                    backend: str | None = None) -> str:
+    """Auto kNN method: the exact sweep when its predicted wall clock beats
+    the hybrid Z-order + NN-descent plan, else ``project``.
+
+    The reference exposes the method as a user knob (``Tsne.scala:74-79``)
+    with no policy; ours is an explicit cost model over the same FLOP
+    counts the bench's MFU accounting uses (``utils/flops.knn_flops``),
+    weighted by the measured per-backend efficiencies above.  At the 60k
+    CPU bench shape it picks the exact sweep — ~100 s at recall 1.0
+    against the hybrid's measured 305.6 s at 0.9393 — and crosses over to
+    the hybrid where the N² term genuinely dominates (~300k on CPU, ~500k
+    on TPU at d=784).  Exact results also make the recall floor moot:
+    the graph IS the ground truth."""
+    if backend is None:
+        backend = jax.default_backend()
+    from tsne_flink_tpu.utils.flops import knn_flops
+    rounds = pick_knn_rounds(n)
+    refine = pick_knn_refine(n, d)
+    exact_s = (knn_flops(n, d, k, "bruteforce")
+               / KNN_EXACT_EFF.get(backend, KNN_EXACT_EFF["cpu"]))
+    hybrid_s = (knn_flops(n, d, k, "project", rounds=rounds,
+                          refine_rounds=refine)
+                / KNN_HYBRID_EFF.get(backend, KNN_HYBRID_EFF["cpu"]))
+    if exact_s > hybrid_s:
+        return "project"
+    if backend != "tpu":
+        # XLA path: keep the per-chunk [c, N] distance transient bounded
+        from tsne_flink_tpu.ops.knn_tiles import pick_knn_tiles
+        c = pick_knn_tiles(n, d, k, backend).row_chunk
+        if c * n * 4 > EXACT_TILE_BYTES_MAX:
+            return "partition"
+    return "bruteforce"
+
+
 def knn_bruteforce(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
-                   *, row_chunk: int | None = None, tiles=None):
+                   *, row_chunk: int | None = None, tiles=None,
+                   kernel: str | None = None):
     """Exact kNN by full N×N tiles (reference bruteforce, TsneHelpers.scala:41-59).
 
-    ``row_chunk=None`` resolves via the tile plan (ops/knn_tiles)."""
+    ``row_chunk=None`` resolves via the tile plan (ops/knn_tiles), which
+    also selects the distance/top-k ``kernel``: under ``pallas`` the whole
+    sweep runs the fused Mosaic kernel (``ops/knn_pallas.fused_knn`` — no
+    [chunk, N] block, no XLA top_k pass) and ``row_chunk`` is moot; the
+    ``xla`` path below is the fallback and the small-shape test oracle."""
     n, dim = x.shape
     k = _clamp_k(k, n)
+    if row_chunk is None or kernel is None:
+        tiles = _resolve_tiles(tiles, n, dim, k)
+    kern = _kernel_of(tiles, kernel)
+    if kern.startswith("pallas"):
+        from tsne_flink_tpu.ops.knn_pallas import fused_knn
+        interp = True if kern == "pallas-interpret" else None
+        return fused_knn(x, k, metric, interpret=interp, tiles=tiles)
     if row_chunk is None:
-        row_chunk = _resolve_tiles(tiles, n, dim, k).row_chunk
+        row_chunk = tiles.row_chunk
     c = min(row_chunk, n)
     nchunks = math.ceil(n / c)
     xp = jnp.pad(x, ((0, nchunks * c - n), (0, 0)))
@@ -169,19 +248,29 @@ def knn_bruteforce(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
 
 def knn_partition(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                   blocks: int = 8, *, row_chunk: int | None = None,
-                  tiles=None):
+                  tiles=None, kernel: str | None = None):
     """Exact kNN with a column-block schedule + streaming top-k merge.
 
     TPU-native analog of the reference's block-cross ``partitionKnn``
     (``TsneHelpers.scala:61-91``): ``blocks`` plays the role of ``knnBlocks`` —
     it bounds the working-set width (memory), not the result, which is
     identical to ``bruteforce``.  ``row_chunk=None`` resolves via the tile
-    plan (ops/knn_tiles).
+    plan (ops/knn_tiles).  Under the ``pallas`` kernel policy the fused
+    Mosaic sweep replaces the whole schedule: its column-tile streaming IS
+    the memory-bounded form (every tile lives in VMEM), and the result
+    contract is the same exact graph.
     """
     n, dim = x.shape
     k = _clamp_k(k, n)
+    if row_chunk is None or kernel is None:
+        tiles = _resolve_tiles(tiles, n, dim, k)
+    kern = _kernel_of(tiles, kernel)
+    if kern.startswith("pallas"):
+        from tsne_flink_tpu.ops.knn_pallas import fused_knn
+        interp = True if kern == "pallas-interpret" else None
+        return fused_knn(x, k, metric, interpret=interp, tiles=tiles)
     if row_chunk is None:
-        row_chunk = _resolve_tiles(tiles, n, dim, k).row_chunk
+        row_chunk = tiles.row_chunk
     blocks = max(1, min(blocks, n))
     b = math.ceil(n / blocks)
     xcols = jnp.pad(x, ((0, blocks * b - n), (0, 0))).reshape(blocks, b, dim)
@@ -321,7 +410,8 @@ def _cand_vectors(base: jnp.ndarray, cand: jnp.ndarray,
 
 
 def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
-                 cand: jnp.ndarray, compact: bool = False) -> jnp.ndarray:
+                 cand: jnp.ndarray, compact: bool = False,
+                 kernel: str = "xla") -> jnp.ndarray:
     """Squared euclidean distances row -> candidates, [c] x [c, Z] -> [c, Z].
 
     On accelerators: ONE batched matmul (``dot_general`` with batch dim c —
@@ -332,7 +422,16 @@ def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
     at 30k x 450 x 784 — /tmp r4 microbench), so there the elementwise
     broadcast is kept; the backend is static at trace time.  ``compact``
     routes the vector gather through :func:`_compact_gather` (identical
-    values, each unique row fetched once)."""
+    values, each unique row fetched once).  ``kernel`` ("pallas" /
+    "pallas-interpret", from the tile plan's resolved policy) runs the
+    norm-combine + feature reduction as the fused Pallas scorer
+    (``ops/knn_pallas.cand_sqdist_fused``) instead — same contract, the
+    [c, Z, f] operand tiles stay in VMEM."""
+    if kernel.startswith("pallas"):
+        from tsne_flink_tpu.ops.knn_pallas import cand_sqdist_fused
+        interp = True if kernel == "pallas-interpret" else None
+        return cand_sqdist_fused(base, sq, rows, cand, compact,
+                                 interpret=interp)
     pr = base[rows]                                     # [c, f]
     pc = _cand_vectors(base, cand, compact)             # [c, Z, f]
     if jax.default_backend() == "cpu":
@@ -347,7 +446,7 @@ def _cand_sqdist(base: jnp.ndarray, sq: jnp.ndarray, rows: jnp.ndarray,
 
 def _cand_exact(metric: str, xf: jnp.ndarray, cache: jnp.ndarray,
                 rows: jnp.ndarray, cand: jnp.ndarray,
-                compact: bool = False) -> jnp.ndarray:
+                compact: bool = False, kernel: str = "xla") -> jnp.ndarray:
     """Exact CLI-metric distances row -> candidates; accelerator backends use
     the same matmul form as :func:`tsne_flink_tpu.ops.metrics.pairwise` (so
     band-swept and refined graph entries carry formula-identical values),
@@ -364,7 +463,7 @@ def _cand_exact(metric: str, xf: jnp.ndarray, cache: jnp.ndarray,
         from tsne_flink_tpu.ops.metrics import metric_fn
         return metric_fn(metric)(xf[rows][:, None, :],
                                  _cand_vectors(xf, cand, compact))
-    d2 = _cand_sqdist(xf, cache, rows, cand, compact)
+    d2 = _cand_sqdist(xf, cache, rows, cand, compact, kernel)
     return jnp.sqrt(d2) if metric == "euclidean" else d2
 
 
@@ -472,7 +571,9 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
     s = min(sample, k)
     dim = xf.shape[1]
     if row_chunk is None:
-        row_chunk = _resolve_tiles(tiles, nloc, dim, k).refine_chunk
+        tiles = _resolve_tiles(tiles, nloc, dim, k)
+        row_chunk = tiles.refine_chunk
+    kern = _kernel_of(tiles, None)
     if dedup_gather == "auto":
         # accelerators: compact the funnel's vector gathers (HBM-bound at
         # ~0.04% MFU on-chip, round 5); CPU: measured 2.3x slower, keep off
@@ -613,13 +714,15 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
                 bad = bad | (cand >= n_valid)    # mesh padding rows
             if do_filter:
                 ad = jnp.where(bad, jnp.inf,
-                               _cand_sqdist(proj, psq, rc, cand, compact))
+                               _cand_sqdist(proj, psq, rc, cand, compact,
+                                            kern))
                 _, sel = lax.top_k(-ad, keep)
                 cand = jnp.take_along_axis(cand, sel, axis=1)  # [c, keep]
                 bad = jnp.take_along_axis(bad, sel, axis=1)
             if do_cascade:
                 ad2 = jnp.where(bad, jnp.inf,
-                                _cand_sqdist(proj2, p2sq, rc, cand, compact))
+                                _cand_sqdist(proj2, p2sq, rc, cand, compact,
+                                             kern))
                 _, sel2 = lax.top_k(-ad2, keep2)
                 cand = jnp.take_along_axis(cand, sel2, axis=1)  # [c, keep2]
                 bad = jnp.take_along_axis(bad, sel2, axis=1)
@@ -630,7 +733,8 @@ def knn_refine(x: jnp.ndarray, idx: jnp.ndarray, dist: jnp.ndarray,
             # deferred-exact variant that let JL values arbitrate the final
             # top-k measured 0.25 recall@90 vs 0.97 here (r4 sweeps)
             dd = jnp.where(bad, jnp.inf,
-                           _cand_exact(metric, xf, xcache, rc, cand, compact))
+                           _cand_exact(metric, xf, xcache, rc, cand, compact,
+                                       kern))
             if dd.shape[1] > k:
                 # lossless pre-top-k (candidates are per-row UNIQUE): any
                 # id in the final smallest-k of old ∪ new is among the k
@@ -797,7 +901,8 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                         filter_dims: int | str | None = "auto",
                         expand_k: int | str | None = "auto",
                         z_per_cycle: int | None = None, tiles=None,
-                        on_substage=None, **refine_kwargs):
+                        on_substage=None, aot_key: dict | None = None,
+                        **refine_kwargs):
     """The hybrid high-recall plan: a Z-order seed graph, then ``cycles`` of
     (2 fresh Z-order rounds merged in + 1 NN-descent refine round).
 
@@ -844,15 +949,26 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
             subs[name] = subs.get(name, 0.0) + time.time() - t0
             return out
 
-        seed_fn = jax.jit(lambda xx, kk: knn_project(
+        def stage(label, f):
+            """One reused jitted executable per stage; with an ``aot_key``
+            (the prepare stage's plan identity) it is AOT-persisted across
+            processes (utils/aot.wrap) — warm runs load the serialized
+            executable and pay zero trace/lower/compile time."""
+            jf = jax.jit(f)
+            if aot_key is None:
+                return jf
+            from tsne_flink_tpu.utils import aot
+            return aot.wrap(jf, aot_key, f"knn-{label}")
+
+        seed_fn = stage("seed", lambda xx, kk: knn_project(
             xx, k, metric, seed_rounds, kk, tiles=tiles))
         # one executable for EVERY cycle's Z-rounds: start_round enters the
         # math only through `it > 0` and the key is a traced argument
-        cyc_fn = jax.jit(lambda xx, kk: knn_project(
+        cyc_fn = stage("cycle", lambda xx, kk: knn_project(
             xx, k, metric, zpc, kk, start_round=1, tiles=tiles))
-        mrg_fn = jax.jit(lambda i1, d1, i2, d2: merge_rounds(
+        mrg_fn = stage("merge", lambda i1, d1, i2, d2: merge_rounds(
             [d1, d2], [i1, i2], k))
-        ref_fn = jax.jit(lambda xx, ii, dd, kk: knn_refine(
+        ref_fn = stage("refine", lambda xx, ii, dd, kk: knn_refine(
             xx, ii, dd, metric, rounds=1, key=kk, filter_dims=filter_dims,
             expand_k=expand_k, tiles=tiles, **refine_kwargs))
 
@@ -883,7 +999,7 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
 def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
         *, blocks: int = 8, rounds: int | None = None,
         refine: int | None = None, key: jax.Array | None = None,
-        tiles=None, on_substage=None):
+        tiles=None, on_substage=None, aot_key: dict | None = None):
     """Dispatch mirroring ``Tsne.scala:74-79``.  ``rounds=None`` resolves via
     :func:`pick_knn_rounds`, ``refine=None`` via :func:`pick_knn_refine`
     (the N-scaled recall policy; refinement applies to ``project`` only).
@@ -893,16 +1009,26 @@ def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
     ``on_substage`` (callable receiving ``{substage: seconds}``) runs the
     hybrid plan decomposed with host timing — see
     :func:`knn_project_refined`; a caller passing it must NOT wrap this
-    dispatch in ``jax.jit`` (the stages jit themselves)."""
+    dispatch in ``jax.jit`` (the stages jit themselves).
+
+    ``method="auto"`` resolves through :func:`pick_knn_method` — callers
+    that fingerprint or record the plan must resolve it themselves first
+    (``utils/artifacts.resolve_knn_plan``) so what is keyed is what ran."""
+    if method == "auto":
+        method = pick_knn_method(x.shape[0], x.shape[1], k)
     if method in ("bruteforce", "partition"):
         def exact_fn(xx):
             if method == "bruteforce":
                 return knn_bruteforce(xx, k, metric, tiles=tiles)
             return knn_partition(xx, k, metric, blocks, tiles=tiles)
         if on_substage is not None:
+            fn = jax.jit(exact_fn)
+            if aot_key is not None:
+                from tsne_flink_tpu.utils import aot
+                fn = aot.wrap(fn, aot_key, f"knn-{method}")
             t0 = time.time()
             # graftlint: disable=host-sync -- deliberate: substage timing
-            out = jax.block_until_ready(jax.jit(exact_fn)(x))
+            out = jax.block_until_ready(fn(x))
             on_substage({"exact": time.time() - t0})
             return out
         return exact_fn(x)
@@ -913,7 +1039,8 @@ def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
             refine = pick_knn_refine(x.shape[0], x.shape[1])
         if refine > 0:
             return knn_project_refined(x, k, metric, rounds, refine, key,
-                                       tiles=tiles, on_substage=on_substage)
+                                       tiles=tiles, on_substage=on_substage,
+                                       aot_key=aot_key)
         if on_substage is not None:
             t0 = time.time()
             # graftlint: disable=host-sync -- deliberate: substage timing

@@ -67,6 +67,11 @@ TILE_BUDGET_FRACTION = 1 / 16
 MIN_BLOCK = 1024
 MAX_BLOCK = 8192
 
+#: VMEM budget for the fused Pallas kernel's resident tile set (two input
+#: tiles + the distance tile + the top-k accumulators); half the ~16 MB
+#: per-core VMEM, leaving the other half for Mosaic's double buffering.
+PALLAS_VMEM_BUDGET = 8 << 20
+
 #: refine row-chunk bounds.  The CPU floor is the measured optimum
 #: (results/recall_60k_r4.txt: row_chunk 256 was +17% time at 20k vs 64 —
 #: the per-row funnel working set already overflows a 1-core cache at
@@ -89,6 +94,15 @@ class KnnTilePlan:
     block: int          # project banded re-rank row block (band = block + 2k)
     refine_chunk: int   # NN-descent local-join row chunk (knn_refine)
     source: str = "model"
+    #: resolved distance/top-k kernel for the exact tiles and the refine
+    #: candidate scorer: "pallas" (fused Mosaic kernel, ops/knn_pallas) |
+    #: "pallas-interpret" (the CPU parity configuration) | "xla" (the
+    #: chunked pairwise + lax.top_k path).  Resolved by pick_knn_kernel's
+    #: backend policy; riding the plan puts it in every bench record and
+    #: profile, like the tile shapes themselves.
+    kernel: str = "xla"
+    pallas_rows: int = 512   # fused-kernel row tile edge (VMEM-budgeted)
+    pallas_cols: int = 512   # fused-kernel column tile edge
 
     def as_record(self) -> dict:
         """JSON-safe dict for bench records / profile output."""
@@ -138,6 +152,33 @@ def project_block_bytes(b: int, d: int, k: int, *, itemsize: int = 4) -> float:
     the gathered row/column operands plus the [b, band] distance tile."""
     band = b + 2 * k
     return float((b * d + band * d + b * band) * itemsize)
+
+
+def fused_tile_bytes(rows: int, cols: int, d: int, k: int, *,
+                     itemsize: int = 4) -> float:
+    """Resident VMEM bytes of one fused-kernel tile step (ops/knn_pallas):
+    the two feature tiles, the [rows, cols] distance tile, and the
+    dist+idx top-k accumulators at the lane-padded width."""
+    lanes = 128
+    dpad = -(-d // lanes) * lanes
+    kpad = max(lanes, -(-k // lanes) * lanes)
+    return float(((rows + cols) * dpad + rows * cols) * itemsize
+                 + rows * kpad * (itemsize + 4))
+
+
+def _pallas_tiles(d: int, k: int) -> tuple[int, int]:
+    """Fused-kernel tile edges: start at the 512 defaults and halve the
+    larger edge until the resident set fits PALLAS_VMEM_BUDGET (wide
+    feature axes are what push it out).  Floors keep the distance tile a
+    legal (sublane, lane) multiple."""
+    rows = cols = 512
+    while (fused_tile_bytes(rows, cols, d, k) > PALLAS_VMEM_BUDGET
+           and (rows > 128 or cols > 128)):
+        if rows >= cols and rows > 128:
+            rows //= 2
+        else:
+            cols //= 2
+    return rows, cols
 
 
 def pick_knn_tiles(n: int, d: int, k: int, backend: str | None = None,
@@ -193,8 +234,17 @@ def pick_knn_tiles(n: int, d: int, k: int, backend: str | None = None,
     row_chunk = _pow2_at_most(tile_budget / (max(d, 1) * 4 * 2), 128, 1024)
     col_block = _pow2_at_most(tile_budget / (max(row_chunk, 1) * 4), 1024,
                               8192)
+    # distance/top-k kernel: the backend policy (Mosaic on TPU with a
+    # runtime lowering probe, XLA tiles elsewhere; TSNE_KNN_KERNEL
+    # overrides) — resolved here so the selection rides the plan into
+    # bench records and profiles
+    from tsne_flink_tpu.ops.knn_pallas import pick_knn_kernel
+    kernel = pick_knn_kernel(backend)
+    pallas_rows, pallas_cols = _pallas_tiles(d, k)
     return KnnTilePlan(row_chunk=row_chunk, col_block=col_block, block=block,
-                       refine_chunk=refine_chunk, source="model")
+                       refine_chunk=refine_chunk, source="model",
+                       kernel=kernel, pallas_rows=pallas_rows,
+                       pallas_cols=pallas_cols)
 
 
 def autotune_knn_tiles(x, k: int, metric: str = "sqeuclidean", *,

@@ -68,7 +68,8 @@ class ShardedOptimizer:
     one uninterrupted run.
     """
 
-    def __init__(self, cfg: TsneConfig, n: int, n_devices: int | None = None):
+    def __init__(self, cfg: TsneConfig, n: int, n_devices: int | None = None,
+                 aot_plan=None):
         self.cfg = cfg
         self.n = n
         self.mesh = make_mesh(n_devices)
@@ -77,6 +78,13 @@ class ShardedOptimizer:
         self.n_padded = math.ceil(n / d) * d
         self.n_local = self.n_padded // d
         self._fns = {}  # num_iters (static) -> compiled segment runner
+        #: graftcheck PlanConfig identifying this run for the AOT
+        #: executable cache (utils/aot.py): with it, each segment
+        #: executable is serialized/warm-loaded across processes keyed on
+        #: (plan hash, segment key, cfg, jax version, backend, host
+        #: signature).  None (library callers) = in-process jit only.
+        self.aot_plan = aot_plan
+        self._aot_fns = {}
 
     def _segment_fn(self, num_iters: int, with_edges: bool = False,
                     trace_edge_pad: int | None = None,
@@ -136,6 +144,24 @@ class ShardedOptimizer:
                 ))
         self._fns[key] = fn
         return fn
+
+    def _maybe_aot(self, fn, key):
+        """AOT-persist a segment executable (utils/aot.wrap) when this run
+        carries a plan identity; the jit wrapper in ``_fns`` stays the
+        compile-audit's ground truth and ``lower()``'s entry point."""
+        from tsne_flink_tpu.utils import aot
+        if self.aot_plan is None or not aot.enabled():
+            return fn
+        wrapped = self._aot_fns.get(key)
+        if wrapped is None:
+            wrapped = aot.wrap(fn, {**aot.plan_key_parts(self.aot_plan),
+                                    "n": self.n,
+                                    "devices": self.n_devices,
+                                    "segment": repr(key),
+                                    "cfg": repr(self.cfg)},
+                               "optimize-seg")
+            self._aot_fns[key] = wrapped
+        return wrapped
 
     def attraction_plan(self, jidx, jval):
         """Which attraction layout this optimizer will launch for (UNPADDED
@@ -375,10 +401,13 @@ class ShardedOptimizer:
             step = min(seg, total - it)
             if step <= 0:
                 break
-            fn = self._segment_fn(step, with_edges=edges is not None,
-                                  trace_edge_pad=trace_pad,
-                                  edges_extra=extra_edges is not None,
-                                  with_health=health_check)
+            seg_key = (step, edges is not None, trace_pad,
+                       extra_edges is not None, health_check)
+            fn = self._maybe_aot(
+                self._segment_fn(step, with_edges=edges is not None,
+                                 trace_edge_pad=trace_pad,
+                                 edges_extra=extra_edges is not None,
+                                 with_health=health_check), seg_key)
             seg_index += 1
             run_state = state
             if inj is not None:
@@ -402,6 +431,7 @@ class ShardedOptimizer:
                     eta = self.cfg.learning_rate
                     self.cfg = rhealth.halved_eta(self.cfg)
                     self._fns.clear()  # cfg changed: segment fns retrace
+                    self._aot_fns.clear()  # (and their AOT wrappers rekey)
                     state = rhealth.fresh_momentum(state)
                     ev = rhealth.rollback_event(
                         segment_start=it, step=step, eta_before=eta,
@@ -429,6 +459,6 @@ class ShardedOptimizer:
         return (self._unpad(state) if unpad else state), losses
 
 
-def shard_pipeline(cfg: TsneConfig, n: int,
-                   n_devices: int | None = None) -> ShardedOptimizer:
-    return ShardedOptimizer(cfg, n, n_devices)
+def shard_pipeline(cfg: TsneConfig, n: int, n_devices: int | None = None,
+                   aot_plan=None) -> ShardedOptimizer:
+    return ShardedOptimizer(cfg, n, n_devices, aot_plan=aot_plan)

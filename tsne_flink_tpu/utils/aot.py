@@ -1,0 +1,280 @@
+"""Plan-keyed AOT executable persistence — compile the entry functions once.
+
+The persistent XLA compilation cache (``utils/cache.py``) already makes a
+RE-compile cheap, but a warm process still pays trace + lower + cache-probe
+time for every entry function, and nothing measures what compilation
+actually cost a run.  This module adds the deliberate form of what
+BENCH_r04 flagged as a cross-machine hazard (XLA:CPU AOT loading):
+
+* :func:`wrap` turns a ``jax.jit``-ed entry function into a lazily
+  AOT-compiled one.  On its first call it lowers + compiles for the
+  concrete argument shapes, SERIALIZES the executable
+  (``jax.experimental.serialize_executable``), and stores it keyed on the
+  caller's plan identity (typically the graftcheck ``PlanConfig`` hash —
+  :func:`plan_key_parts`), the argument shape/dtype signature, the jax
+  version, the backend, and ``utils/cache.host_signature()``.  A later
+  process deserializes and runs with ZERO lower/compile work.  The host
+  signature makes foreign entries invisible (never SIGILL-loaded), the jax
+  version gates the pickle format, and the shape signature means a
+  deserialized executable can never be bound to mismatched inputs.
+* a process-wide **compile meter** (:func:`compile_snapshot`) taps jax's
+  monitoring events to measure TOTAL backend-compile seconds and counts —
+  the measured-time twin of graftcheck's static ``compile_count``; bench.py
+  samples it around each stage so ``compile_seconds`` is split out of every
+  per-stage wall time.
+
+Enablement: ``TSNE_AOT_CACHE`` (default on) / the CLI's
+``--aotCache/--noAotCache`` via :func:`set_enabled`.  Entries are pickles;
+they are only ever read from the repo-local (or ``TSNE_AOT_DIR``) cache
+this module itself writes, and the key embedded in the entry is verified
+against the expected key before the payload is touched.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+import time
+
+from tsne_flink_tpu.utils.env import env_bool, env_raw
+
+MAGIC = "tsne_flink_tpu-aot-v1"
+
+#: process-global stats: AOT entry hits/misses and lower+compile seconds
+#: spent through :func:`wrap` (the entry-function share of the compile
+#: meter below).
+_STATS = {"hits": 0, "misses": 0, "compile_seconds": 0.0}
+
+_ENABLED_OVERRIDE: bool | None = None
+
+# ---- compile meter ---------------------------------------------------------
+
+_METER = {"count": 0, "seconds": 0.0}
+_METER_INSTALLED = False
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def install_compile_meter() -> None:
+    """Idempotently register a jax monitoring listener accumulating every
+    backend-compile duration — jit, pjit and AOT alike — so entry points
+    can report measured compile seconds per stage."""
+    global _METER_INSTALLED
+    if _METER_INSTALLED:
+        return
+    from jax._src import monitoring
+
+    def _on_duration(event, duration, **_kw):
+        if event == _COMPILE_EVENT:
+            _METER["count"] += 1
+            _METER["seconds"] += float(duration)
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _METER_INSTALLED = True
+
+
+def compile_snapshot() -> dict:
+    """{'count': int, 'seconds': float} compiled so far this process (the
+    meter only counts from :func:`install_compile_meter` on); callers diff
+    two snapshots around a stage."""
+    return dict(_METER)
+
+
+# ---- enablement / stats ----------------------------------------------------
+
+def set_enabled(value: bool | None) -> None:
+    """Process override for the AOT executable cache: True/False force it,
+    None defers to ``TSNE_AOT_CACHE`` (the CLI's --aotCache/--noAotCache)."""
+    global _ENABLED_OVERRIDE
+    _ENABLED_OVERRIDE = value
+
+
+def enabled_override() -> bool | None:
+    """The current process override (for callers that save/restore it,
+    like cli.main around a run)."""
+    return _ENABLED_OVERRIDE
+
+
+def enabled() -> bool:
+    if _ENABLED_OVERRIDE is not None:
+        return _ENABLED_OVERRIDE
+    return env_bool("TSNE_AOT_CACHE")
+
+
+def stats() -> dict:
+    return dict(_STATS)
+
+
+def cache_label() -> str:
+    """One honest word for a record: off, cold (at least one entry was
+    compiled), warm (every wrapped entry loaded), or mixed."""
+    if not enabled():
+        return "off"
+    h, m = _STATS["hits"], _STATS["misses"]
+    if m and h:
+        return "mixed"
+    if m:
+        return "cold"
+    if h:
+        return "warm"
+    return "cold"  # nothing wrapped yet: a cold run until proven warm
+
+
+def default_root() -> str:
+    root = env_raw("TSNE_AOT_DIR")
+    if root:
+        return root
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), ".tsne_aot")
+
+
+# ---- keys ------------------------------------------------------------------
+
+def plan_key_parts(plan) -> dict:
+    """The graftcheck ``PlanConfig`` as AOT key parts: its full JSON dict,
+    so any plan field change (shape, backend, dtype, stage choice, tile-
+    relevant policy input) is a clean cache miss."""
+    return {f"plan.{k}": v for k, v in plan.as_dict().items()}
+
+
+def _args_signature(args, kwargs) -> str:
+    """Shape/dtype signature of the example call: an executable compiled
+    for one layout must never be handed another."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = [str(treedef)]
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            sig.append(repr(leaf))
+        else:
+            sig.append(f"{dtype}{tuple(shape)}")
+    return "|".join(sig)
+
+
+def entry_key(key_parts: dict, args=(), kwargs=None, label: str = "") -> str:
+    """sha256 over (plan key parts, arg signature, jax version, backend,
+    host signature) — the invalidation-safe identity of one executable."""
+    import jax
+
+    from tsne_flink_tpu.utils.cache import host_signature
+    from tsne_flink_tpu.ops.metrics import matmul_dtype
+    parts = dict(key_parts or {})
+    parts.update({
+        "_label": label,
+        "_args": _args_signature(args, kwargs or {}),
+        "_jax": jax.__version__,
+        "_backend": jax.default_backend(),
+        "_host": host_signature(),
+        "_matmul_dtype": str(matmul_dtype()),
+    })
+    blob = repr(sorted((str(k), repr(v)) for k, v in parts.items()))
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+# ---- the executable store --------------------------------------------------
+
+def _path(root: str, label: str, key: str) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in label)
+    return os.path.join(root, f"{safe}-{key}.aot")
+
+
+def _load(root: str, label: str, key: str):
+    from jax.experimental import serialize_executable
+    path = _path(root, label, key)
+    try:
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+        if entry.get("magic") != MAGIC or entry.get("key") != key:
+            raise ValueError("foreign or key-mismatched AOT entry")
+        return serialize_executable.deserialize_and_load(
+            entry["payload"], entry["in_tree"], entry["out_tree"])
+    except FileNotFoundError:
+        return None
+    except Exception:
+        # a damaged/foreign entry is a miss, never a crash: remove so the
+        # cold path's save replaces it (same contract as ArtifactCache)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+
+
+def _save(root: str, label: str, key: str, compiled) -> bool:
+    from jax.experimental import serialize_executable
+    try:
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+    except Exception:
+        return False  # not serializable on this backend: cache is best-effort
+    entry = {"magic": MAGIC, "key": key, "payload": payload,
+             "in_tree": in_tree, "out_tree": out_tree}
+    try:
+        os.makedirs(root, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=root, suffix=".aot.tmp")
+    except OSError:
+        return False
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(entry, f)
+        os.replace(tmp, _path(root, label, key))
+    except (OSError, pickle.PicklingError):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return True
+
+
+class _PersistentFn:
+    """Lazily AOT-compiled callable around a ``jax.jit``-ed function.
+
+    The first call fixes the argument layout: load the serialized
+    executable for (key_parts, layout) or lower + compile + store it.
+    Later calls run the executable directly.  Argument layouts must stay
+    fixed across calls — exactly the contract of the segment/stage entry
+    functions this wraps (``ShardedOptimizer`` keys ragged tails
+    separately; the kNN stage fns see one shape per prepare)."""
+
+    def __init__(self, jitted, key_parts: dict, label: str,
+                 root: str | None = None):
+        self._jitted = jitted
+        self._key_parts = dict(key_parts or {})
+        self._label = label
+        self._root = root or default_root()
+        self._compiled = None
+        self.cache_state = "off"
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            key = entry_key(self._key_parts, args, kwargs, self._label)
+            got = _load(self._root, self._label, key)
+            if got is not None:
+                self._compiled = got
+                self.cache_state = "warm"
+                _STATS["hits"] += 1
+            else:
+                t0 = time.time()
+                compiled = self._jitted.lower(*args, **kwargs).compile()
+                _STATS["compile_seconds"] += time.time() - t0
+                _STATS["misses"] += 1
+                self.cache_state = ("cold" if _save(self._root, self._label,
+                                                    key, compiled)
+                                    else "uncached")
+                self._compiled = compiled
+        return self._compiled(*args, **kwargs)
+
+
+def wrap(jitted, key_parts: dict, label: str, root: str | None = None):
+    """AOT-persist ``jitted`` under the plan identity ``key_parts`` when
+    the cache is enabled; otherwise return ``jitted`` unchanged.  The
+    returned callable is a drop-in for same-layout calls."""
+    if not enabled():
+        return jitted
+    return _PersistentFn(jitted, key_parts, label, root)

@@ -260,16 +260,26 @@ class PrepareResult:
         return "mixed"
 
 
-def resolve_knn_plan(n: int, d: int, method: str, rounds, refine):
+def resolve_knn_plan(n: int, d: int, method: str, rounds, refine, k=None,
+                     backend=None):
     """Resolve the auto kNN plan EXACTLY like ops/knn.knn does, so the
-    fingerprint and the dispatched computation can never disagree."""
+    fingerprint and the dispatched computation can never disagree.
+    Returns the RESOLVED ``(method, rounds, refine)`` triple:
+    ``method="auto"`` goes through ``ops/knn.pick_knn_method`` (round 7),
+    so the fingerprint keys the method that actually runs.  ``backend``
+    only matters for auditing a foreign backend's plan (graftcheck);
+    None = the live backend, which is what prepare launches on."""
+    if method == "auto":
+        from tsne_flink_tpu.ops.knn import pick_knn_method
+        method = pick_knn_method(n, d, int(k if k is not None else 90),
+                                 backend)
     if method == "project":
         from tsne_flink_tpu.ops.knn import pick_knn_refine, pick_knn_rounds
         if rounds is None:
             rounds = pick_knn_rounds(n)
         if refine is None:
             refine = pick_knn_refine(n, d)
-    return rounds, refine
+    return method, rounds, refine
 
 
 def prepare_fingerprints(x=None, knn=None, *, neighbors: int,
@@ -292,12 +302,13 @@ def prepare_fingerprints(x=None, knn=None, *, neighbors: int,
                               **_backend_parts()})
     else:
         n, d = int(x.shape[0]), int(x.shape[1])
-        rounds, refine = resolve_knn_plan(n, d, knn_method, knn_rounds,
-                                          knn_refine)
+        method, rounds, refine = resolve_knn_plan(n, d, knn_method,
+                                                  knn_rounds, knn_refine,
+                                                  k=k)
         key_data = (None if key is None
                     else np.asarray(jax.random.key_data(key)))
         knn_fp = knn_fingerprint(
-            data_fingerprint(x), n=n, d=d, k=k, method=knn_method,
+            data_fingerprint(x), n=n, d=d, k=k, method=method,
             metric=metric, rounds=rounds, refine=refine, blocks=knn_blocks,
             key_data=key_data, dtype=np.asarray(x[:0]).dtype)
     import tsne_flink_tpu.ops.affinities as aff
@@ -365,8 +376,8 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
         knn_cache = "input"
     else:
         n, d = int(x.shape[0]), int(x.shape[1])
-        rounds, refine = resolve_knn_plan(n, d, knn_method, knn_rounds,
-                                          knn_refine)
+        knn_method, rounds, refine = resolve_knn_plan(
+            n, d, knn_method, knn_rounds, knn_refine, k=k)
         got = (cache.load(KIND_KNN, knn_fp, ("idx", "dist"))
                if cache is not None else None)
         if got is not None:
@@ -385,11 +396,27 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
             tiles_rec = tiles.as_record()
             # decomposed per-substage dispatch (ops/knn.knn on_substage):
             # each stage is its own reused jitted executable — compiles
-            # shrink and the substage breakdown is a free byproduct
+            # shrink and the substage breakdown is a free byproduct.  With
+            # the AOT executable cache on, each stage fn is additionally
+            # serialized keyed on this prepare's graftcheck plan twin
+            # (round 7): a warm process loads the compiled executables and
+            # pays zero trace/lower/compile time for the kNN stage.
+            from tsne_flink_tpu.utils import aot
+            aot_key = None
+            if aot.enabled():
+                from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+                plan = PlanConfig(n=n, d=d, k=k,
+                                  backend=jax.default_backend(),
+                                  knn_method=knn_method, knn_rounds=rounds,
+                                  knn_refine=refine, name="prepare")
+                aot_key = {**aot.plan_key_parts(plan), "metric": metric,
+                           "dtype": str(np.asarray(x[:0]).dtype),
+                           "tiles": tiles.as_record()}
             subs: dict = {}
             idx, dist = knn_dispatch(
                 x, k, knn_method, metric, blocks=knn_blocks, rounds=rounds,
-                refine=refine, key=key, tiles=tiles, on_substage=subs.update)
+                refine=refine, key=key, tiles=tiles, on_substage=subs.update,
+                aot_key=aot_key)
             idx.block_until_ready()
             knn_subs = {kk: round(v, 3) for kk, v in subs.items()}
             knn_cache = "off"

@@ -80,5 +80,9 @@ def enable_compilation_cache(path: str | None = None) -> None:
         path = os.path.join(root, host_signature())
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # 0.0, not the jax default 1.0 (round 7): the decomposed kNN plan and
+    # the affinity builders are many SMALL executables — most compile in
+    # under a second, fell below the old threshold, and were silently
+    # recompiled by every process.  Pinned by tests/test_aot.py.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -29,7 +29,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", required=True)
     p.add_argument("--dimension", type=int, required=True)
     p.add_argument("--knnMethod", required=True,
-                   choices=["bruteforce", "partition", "project"])
+                   choices=["auto", "bruteforce", "partition", "project"])
     p.add_argument("--inputDistanceMatrix", action="store_true")
     p.add_argument("--executionPlan", action="store_true")
     p.add_argument("--metric", default="sqeuclidean",
@@ -153,6 +153,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="embed the assembled P arrays in every checkpoint "
                         "(larger files) so --resume skips the whole prepare "
                         "stage even without the artifact cache")
+    p.add_argument("--aotCache", dest="aotCache", action="store_true",
+                   default=None,
+                   help="force the plan-keyed AOT executable cache "
+                        "(utils/aot.py) ON, over $TSNE_AOT_CACHE=0: "
+                        "compiled kNN/optimize-segment executables are "
+                        "serialized keyed on the plan hash + jax version "
+                        "+ backend + host signature, and later processes "
+                        "warm-load them (compile seconds ~ 0)")
+    p.add_argument("--noAotCache", dest="aotCache", action="store_false",
+                   help="disable the AOT executable cache for this run")
     p.add_argument("--cacheDir", default=None,
                    help="prepare-artifact cache root (kNN graph + assembled "
                         "P, content-addressed .npz; utils/artifacts.py). "
@@ -447,11 +457,14 @@ def main(argv=None) -> int:
     mixed-precision setting (--dtype bfloat16) cannot leak into a later
     in-process caller (tests call main() directly)."""
     from tsne_flink_tpu.ops.metrics import matmul_dtype, set_matmul_dtype
+    from tsne_flink_tpu.utils import aot
     prev = matmul_dtype()
+    prev_aot = aot.enabled_override()
     try:
         return _main(argv)
     finally:
         set_matmul_dtype(prev)
+        aot.set_enabled(prev_aot)
 
 
 def _main(argv=None) -> int:
@@ -460,6 +473,13 @@ def _main(argv=None) -> int:
 
     from tsne_flink_tpu.utils.cache import enable_compilation_cache
     enable_compilation_cache()
+
+    # AOT executable persistence: --aotCache/--noAotCache override the
+    # TSNE_AOT_CACHE default; the compile meter makes measured compile
+    # seconds available to any caller that wants the split
+    from tsne_flink_tpu.utils import aot
+    aot.set_enabled(args.aotCache)
+    aot.install_compile_meter()
 
     if env_bool("TSNE_FORCE_CPU"):
         # dev/test escape hatch: the container's sitecustomize latches the
@@ -614,6 +634,13 @@ def _main(argv=None) -> int:
         x = jnp.asarray(x_np, dtype)
         spmd_data = x
         spmd_knn_method = args.knnMethod
+        if spmd_knn_method == "auto":
+            # SpmdPipeline takes a concrete method; resolve the auto
+            # policy here exactly like prepare would (ops/knn
+            # .pick_knn_method via resolve_knn_plan)
+            spmd_knn_method, _, _ = art.resolve_knn_plan(
+                n, int(args.dimension), "auto", args.knnIterations,
+                args.knnRefine, k=neighbors)
 
     cfg = TsneConfig(
         n_components=args.nComponents,
@@ -642,7 +669,8 @@ def _main(argv=None) -> int:
     # divergence sentinel (--healthCheck); every recovery decision lands
     # on its event list, which rides the checkpoint payload
     from tsne_flink_tpu.runtime.supervisor import Supervisor
-    supervisor = Supervisor(_run_plan(args, cfg, n, assembly, neighbors),
+    run_plan = _run_plan(args, cfg, n, assembly, neighbors)
+    supervisor = Supervisor(run_plan,
                             max_retries=args.maxRetries, on_oom=args.onOom,
                             health_check=args.healthCheck)
 
@@ -809,7 +837,8 @@ def _main(argv=None) -> int:
         state = init_working_set(jax.random.key(args.randomState), n,
                                  cfg.n_components, dtype)
 
-    runner = shard_pipeline(cfg, n, n_devices=args.devices)
+    runner = shard_pipeline(cfg, n, n_devices=args.devices,
+                            aot_plan=run_plan)
 
     if args.executionPlan:
         lowered = runner.lower(state, jidx, jval)
@@ -829,7 +858,8 @@ def _main(argv=None) -> int:
         jax.profiler.start_trace(args.profile)
     state, losses = supervisor.run_optimize(
         lambda c: (runner if c is cfg
-                   else shard_pipeline(c, n, n_devices=args.devices)),
+                   else shard_pipeline(c, n, n_devices=args.devices,
+                                       aot_plan=run_plan)),
         cfg, state, jidx, jval, start_iter=start_iter,
         loss_carry=loss_carry, checkpoint_every=args.checkpointEvery,
         checkpoint_cb=_make_checkpoint_cb(args, save_payload, supervisor,

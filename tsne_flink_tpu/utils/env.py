@@ -89,6 +89,13 @@ _declare("TSNE_KNN_AUTOTUNE", "bool", False,
          "Empirically autotune the kNN refine tile plan on a row slice "
          "before the kNN stage (the CLI's --knnAutotune; recall-invariant "
          "by construction).")
+_declare("TSNE_KNN_KERNEL", "str", "auto",
+         "Distance/top-k kernel for the exact kNN tiles and the refine "
+         "candidate scorer (ops/knn_pallas.pick_knn_kernel). 'auto' runs "
+         "the fused Pallas kernel on TPU (Mosaic lowering probe, XLA "
+         "fallback) and the XLA tile path elsewhere; 'interpret' forces "
+         "interpret-mode Pallas (the CPU parity-test configuration).",
+         choices=("auto", "pallas", "interpret", "xla"))
 
 # ---- runtime resilience (tsne_flink_tpu/runtime/) --------------------------
 _declare("TSNE_FAULT_PLAN", "str", None,
@@ -124,6 +131,15 @@ _declare("TSNE_ARTIFACT_DIR", "path", None,
 _declare("TSNE_TPU_CACHE_DIR", "path", None,
          "Persistent XLA compilation cache root (utils/cache.py). Default: "
          "repo-local .jax_cache (which also gets the legacy-entry sweep).")
+_declare("TSNE_AOT_CACHE", "bool", True,
+         "Plan-keyed AOT executable persistence (utils/aot.py): serialize "
+         "the compiled kNN / optimize-segment entry executables keyed on "
+         "the graftcheck plan hash + jax version + backend + host "
+         "signature, and warm-load them in later processes (compile "
+         "seconds ~ 0). The CLI's --aotCache/--noAotCache overrides.")
+_declare("TSNE_AOT_DIR", "path", None,
+         "AOT executable cache root (utils/aot.py). Default: repo-local "
+         ".tsne_aot (sibling of .jax_cache / .tsne_artifacts).")
 _declare("TSNE_TPU_NATIVE_CACHE", "path", None,
          "Build directory for the ctypes native CSV runtime "
          "(utils/native.py). Default: tsne_flink_tpu/native/build.")
