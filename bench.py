@@ -78,7 +78,8 @@ RECORD_BASE_KEYS = (
     "metric", "unit", "backend", "devices", "n", "iterations", "repulsion",
     "theta", "knn_method", "knn_rounds", "knn_refine", "data", "data_seed",
     "peak_flops", "peak_flops_basis", "assembly", "cache", "matmul_dtype",
-    "knn_tiles", "audit", "degradations", "aot_cache",
+    "knn_tiles", "audit", "degradations", "aot_cache", "memory",
+    "host_calib",
 )
 
 
@@ -334,6 +335,25 @@ def main():
     from tsne_flink_tpu.utils import aot
     aot.install_compile_meter()
 
+    # obsgraft (tsne_flink_tpu/obs/): the bench ALWAYS records the span
+    # trace + a metrics snapshot — every stage timing below is sourced
+    # from obs spans, and the Perfetto-loadable trace is the run's
+    # attributability evidence (ROADMAP items 2/4 presuppose it)
+    from tsne_flink_tpu.obs import calibrate as obcal
+    from tsne_flink_tpu.obs import memory as obmem
+    from tsne_flink_tpu.obs import metrics as obmetrics
+    from tsne_flink_tpu.obs import trace as obtrace
+    _default_trace = os.path.join("results", "bench_trace.json")
+    _raw_trace = env_str("TSNE_TRACE", default=None)
+    if _raw_trace and _raw_trace.lower() in ("0", "false", "no", "off"):
+        trace_path = None  # explicit opt-out
+    else:
+        obtrace.set_enabled(True)
+        trace_path = obtrace.env_trace_path(_default_trace) or _default_trace
+    metrics_path = (env_str("TSNE_METRICS_OUT", default=None)
+                    or os.path.join("results", "bench_metrics.json"))
+    telemetry_on = env_bool("TSNE_TELEMETRY")
+
     # ---- analytic FLOP model + MFU (VERDICT r2 weak #2): computed UP FRONT
     # so every partial record can scale the unmeasured remainder by the
     # measured FLOP rate, and the record is grade-ready the moment any
@@ -384,6 +404,34 @@ def main():
                  "hbm_budget": _hbm["hbm_budget"], "ok": _hbm["ok"],
                  "compile_count": plan_compile_count(_plan, seg)}
 
+    # host-calibration probe (obs/calibrate.py): measured matmul GFLOP/s +
+    # cache.host_signature() on every record, so cross-round stage ratios
+    # are normalizable after the fact (the r5-vs-r6 host-speed confound:
+    # identical code, 1.7-3x slower host, records said nothing)
+    host_calib = obcal.host_calibration()
+
+    # predicted-vs-observed memory (obs/memory.py beside the graftcheck
+    # model): per-stage observed watermark + drift ratio, updated in place
+    # as stages complete so every superseding record carries the latest
+    _gib_b = 1 << 30
+    _pred_stage = {st: int(float(terms["peak"]) * _gib_b)
+                   for st, terms in _hbm["stages"].items()}
+    mem_rec = {"basis": obmem.observed_peak_bytes()[1],
+               "predicted_peak": _hbm["peak_hbm_est"],
+               "hbm_budget": _hbm["hbm_budget"], "stages": {}}
+
+    def mem_mark(stage):
+        s = obmem.sample(stage)
+        mem_rec["stages"][stage] = {
+            "observed_bytes": s["observed_bytes"],
+            "predicted_bytes": _pred_stage.get(stage),
+            "drift": obmem.drift(s["observed_bytes"],
+                                 _pred_stage.get(stage))}
+        peak_obs = max(v["observed_bytes"]
+                       for v in mem_rec["stages"].values())
+        mem_rec["observed_peak"] = peak_obs
+        mem_rec["drift"] = obmem.drift(peak_obs, _hbm["peak_hbm_est"])
+
     # run supervisor (tsne_flink_tpu/runtime/): the OOM degradation ladder
     # + divergence sentinel around prepare and the segmented optimize;
     # its ladder steps ride EVERY record ("degradations") so a degraded
@@ -427,6 +475,13 @@ def main():
         # mixed — overwritten at every emission, so a cold and a warm-AOT
         # process emit DISTINCT records for the same workload
         "aot_cache": aot.cache_label(),
+        # per-stage observed memory watermark beside graftcheck's
+        # predicted peak (obs/memory.py) — mem_rec is updated in place at
+        # every stage mark, so later emissions carry the growing map
+        "memory": mem_rec,
+        # measured host speed + signature (obs/calibrate.py): the
+        # cross-round normalization anchor
+        "host_calib": host_calib,
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
@@ -477,6 +532,7 @@ def main():
     # — a warm run must never claim the arithmetic it skipped.
     def on_stage(stage, secs, cache_state):
         compile_mark(stage)
+        mem_mark(stage)
         if stage != "knn":
             return
         f_knn_m = 0.0 if cache_state == "warm" else f_knn
@@ -548,14 +604,16 @@ def main():
     # ---- optimize, in fixed-size bit-identical segments (one compiled
     # executable — start_iter and the loss trace are traced arguments) with
     # a superseding record after each; stop when the next segment would
-    # cross the deadline and extrapolate the rest
+    # cross the deadline and extrapolate the rest.  The stage timer is an
+    # obs span (sp_opt) — bench stage timings are span-sourced, and each
+    # segment inside it is its own optimize.segment span (mesh.py)
     margin = env_float("TSNE_BENCH_MARGIN_S")
-    t2 = time.time()
+    sp_opt = obtrace.begin("optimize", cat="stage")
     prog = {"it": 0, "state": state, "losses": None,
-            "last_seg_s": None, "t_prev": t2}
+            "last_seg_s": None, "t_prev": 0.0}
 
     def opt_elapsed():
-        return time.time() - t2
+        return sp_opt.elapsed()
 
     def est_total_at(it_done):
         if it_done <= 0:
@@ -565,13 +623,14 @@ def main():
 
     def cb(state_u, next_iter, losses):
         jax.block_until_ready(state_u.y)
-        now = time.time()
+        now = opt_elapsed()  # span-sourced segment timing
         prog.update(it=next_iter, state=state_u, losses=losses,
                     last_seg_s=now - prog["t_prev"], t_prev=now)
-        measured = t_knn + t_aff + opt_elapsed()
+        mem_mark("optimize")
+        measured = t_knn + t_aff + now
         emit_partial(measured, est_total_at(next_iter),
                      {"knn": t_knn, "affinities": t_aff,
-                      "optimize": opt_elapsed()},
+                      "optimize": now},
                      f"optimize extrapolated from {next_iter}/{iters} iters")
         if _remaining() < prog["last_seg_s"] + margin:
             raise _DeadlineStop
@@ -584,7 +643,7 @@ def main():
             lambda c: (runner if c is cfg
                        else ShardedOptimizer(c, n, aot_plan=_plan)),
             cfg, state, jidx, jval, checkpoint_every=seg,
-            checkpoint_cb=cb, extra_edges=extra)
+            checkpoint_cb=cb, extra_edges=extra, telemetry=telemetry_on)
         it_done = iters
     except _DeadlineStop:
         state, losses = prog["state"], prog["losses"]
@@ -592,8 +651,9 @@ def main():
         print(f"# deadline {_deadline_s():.0f}s: stopped after {it_done}/"
               f"{iters} iters; extrapolating", file=sys.stderr)
     jax.block_until_ready(state.y)
-    t_opt = time.time() - t2
+    t_opt = sp_opt.end().seconds
     compile_mark("optimize")
+    mem_mark("optimize")
 
     complete = it_done == iters
     total = (t_knn + t_aff + t_opt if complete
@@ -653,10 +713,37 @@ def main():
            "compile_seconds": dict(compile_s),
            "compile_counts": dict(compile_n),
            "aot_cache": aot.cache_label(), "aot": aot.stats()}
+    if telemetry_on and sup.last_telemetry is not None:
+        # in-loop telemetry (models/tsne TELEMETRY_FIELDS): the last
+        # recorded slot's values ride the record; the full trace is in
+        # the metrics snapshot sidecar
+        from tsne_flink_tpu.models.tsne import TELEMETRY_FIELDS
+        tel = sup.last_telemetry
+        slot = max(0, min(it_done // LOSS_EVERY - 1, tel.shape[0] - 1))
+        rec["telemetry"] = {f: round(float(v), 6) for f, v in
+                            zip(TELEMETRY_FIELDS, tel[slot])}
+        for f, v in rec["telemetry"].items():
+            obmetrics.gauge(f"telemetry.{f}").set(v)
+    # ONE metrics snapshot on the final record (obs/metrics.py absorbs
+    # the compile meter, AOT stats and runtime recovery counters)
+    rec["metrics"] = obmetrics.snapshot()
     if not complete:
         rec.update(extrapolated=True, iterations_run=it_done,
                    measured_seconds=round(measured_s, 3))
     _emit(rec)
+    # obs exports: the Perfetto-loadable Chrome trace + the metrics
+    # snapshot sidecar (paths via TSNE_TRACE / TSNE_METRICS_OUT)
+    try:
+        if trace_path:
+            obtrace.write(trace_path)
+            print(f"# obs trace written to {trace_path}", file=sys.stderr)
+        obmetrics.write_snapshot(metrics_path, extra={"run": {
+            "n": n, "iterations": iters, "backend": backend,
+            "repulsion": repulsion, "knn_method": knn_method}})
+        print(f"# obs metrics snapshot written to {metrics_path}",
+              file=sys.stderr)
+    except OSError:
+        pass  # read-only results dir: exports are best-effort
 
 
 if __name__ == "__main__":

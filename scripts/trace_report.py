@@ -1,0 +1,174 @@
+"""Render a per-stage/per-segment summary table from an obs trace file.
+
+Reads either export format of ``tsne_flink_tpu/obs/trace.py`` — the
+Chrome-trace JSON (``traceEvents``) or the JSONL event log — and prints a
+per-span-name summary (count, total/mean/max seconds, share of the
+longest enclosing span) plus an optimize-segment table when segments are
+present.  The terminal twin of loading the trace in Perfetto.
+
+Usage:
+  python scripts/trace_report.py <trace.json|trace.jsonl> [--json]
+  python scripts/trace_report.py --smoke
+
+``--smoke`` (tier-1, tests/test_obs.py): generates a tiny in-process
+trace with the real tracer, writes it to a temp file, and reports on it —
+proving the emit -> load -> aggregate loop end to end without JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def load_events(path: str) -> list[dict]:
+    """Normalized event dicts (name, cat, ts, dur seconds, args) from
+    either export format."""
+    events = []
+    with open(path) as f:
+        if path.endswith(".jsonl"):
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+        else:
+            payload = json.load(f)
+            for ev in payload.get("traceEvents", []):
+                events.append({
+                    "name": ev.get("name"), "cat": ev.get("cat"),
+                    "ts": ev.get("ts", 0) / 1e6,
+                    "dur": (ev["dur"] / 1e6 if ev.get("ph") == "X"
+                            and "dur" in ev else None),
+                    "args": ev.get("args", {})})
+    return events
+
+
+def summarize(events: list[dict]) -> dict:
+    """{"spans": {name: {count,total,mean,max}}, "segments": [...],
+    "instants": {name: count}, "wall": float}."""
+    spans: dict[str, dict] = {}
+    instants: dict[str, int] = {}
+    segments = []
+    wall = 0.0
+    for e in events:
+        if e.get("dur") is None:
+            instants[e["name"]] = instants.get(e["name"], 0) + 1
+            continue
+        s = spans.setdefault(e["name"], {"count": 0, "total": 0.0,
+                                         "max": 0.0})
+        s["count"] += 1
+        s["total"] += e["dur"]
+        s["max"] = max(s["max"], e["dur"])
+        wall = max(wall, e["dur"])
+        if e["name"] == "optimize.segment":
+            a = e.get("args", {})
+            segments.append({"seg": a.get("seg"),
+                             "start_iter": a.get("start_iter"),
+                             "num_iters": a.get("num_iters"),
+                             "seconds": round(e["dur"], 4),
+                             "rollback": bool(a.get("rollback"))})
+    for s in spans.values():
+        s["mean"] = s["total"] / s["count"]
+    segments.sort(key=lambda r: (r["seg"] or 0, r["start_iter"] or 0))
+    return {"spans": spans, "segments": segments, "instants": instants,
+            "wall": wall}
+
+
+def render(summary: dict) -> str:
+    lines = []
+    spans = summary["spans"]
+    if not spans:
+        return "trace_report: no span events in this trace"
+    wall = summary["wall"] or 1e-12
+    name_w = max(len(n) for n in spans) + 2
+    lines.append(f"{'span':<{name_w}} {'count':>5} {'total s':>10} "
+                 f"{'mean s':>10} {'max s':>10} {'share':>7}")
+    for name, s in sorted(spans.items(), key=lambda kv: -kv[1]["total"]):
+        lines.append(
+            f"{name:<{name_w}} {s['count']:>5} {s['total']:>10.4f} "
+            f"{s['mean']:>10.4f} {s['max']:>10.4f} "
+            f"{s['total'] / wall:>6.1%}")
+    if summary["segments"]:
+        lines.append("")
+        lines.append(f"{'seg':>4} {'start_iter':>11} {'iters':>6} "
+                     f"{'seconds':>9}  flags")
+        for r in summary["segments"]:
+            lines.append(f"{r['seg'] or 0:>4} {r['start_iter'] or 0:>11} "
+                         f"{r['num_iters'] or 0:>6} {r['seconds']:>9.4f}"
+                         f"  {'rollback' if r['rollback'] else ''}")
+    if summary["instants"]:
+        lines.append("")
+        lines.append("instants: " + ", ".join(
+            f"{n} x{c}" for n, c in sorted(summary["instants"].items())))
+    return "\n".join(lines)
+
+
+def _smoke(out_json: bool) -> int:
+    """Emit a real (tiny) trace through the tracer and report on it —
+    the tier-1 pin that the whole export/report loop works, JAX-free."""
+    import tempfile
+
+    from tsne_flink_tpu.obs import trace
+
+    trace.set_enabled(True)
+    trace.reset()
+    with trace.span("prepare.knn", cat="prepare", cache="off"):
+        with trace.span("knn.exact", cat="knn"):
+            pass
+    with trace.span("prepare.affinities", cat="prepare"):
+        pass
+    for seg, start in ((1, 0), (2, 10)):
+        with trace.span("optimize.segment", cat="optimize", seg=seg,
+                        start_iter=start, num_iters=10):
+            pass
+    trace.instant("supervisor.oom", cat="runtime", stage="knn")
+    with tempfile.TemporaryDirectory() as d:
+        path = trace.write(os.path.join(d, "smoke_trace.json"))
+        summary = summarize(load_events(path))
+    trace.set_enabled(None)
+    trace.reset()
+    ok = (summary["spans"].get("optimize.segment", {}).get("count") == 2
+          and "prepare.knn" in summary["spans"]
+          and summary["instants"].get("supervisor.oom") == 1)
+    if out_json:
+        print(json.dumps({"ok": ok, "summary": {
+            "spans": summary["spans"], "instants": summary["instants"],
+            "segments": summary["segments"]}}))
+    else:
+        print(render(summary))
+        print(f"\nsmoke: {'ok' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-stage/per-segment summary of an obs trace file")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="trace file (Chrome-trace .json or .jsonl)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-contained smoke: emit a tiny trace through "
+                         "the real tracer and report on it (tier-1)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return _smoke(args.json)
+    if not args.trace:
+        ap.error("a trace file is required (or --smoke)")
+    summary = summarize(load_events(args.trace))
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closing stdout is not an error
+        sys.exit(0)

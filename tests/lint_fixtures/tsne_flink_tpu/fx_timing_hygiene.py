@@ -1,0 +1,24 @@
+"""timing-hygiene fixture (lives under a tsne_flink_tpu/ directory because
+the rule scopes by path): one raw clock per flavor, plus suppressed and
+never-flagged twins."""
+
+import time
+from time import perf_counter
+
+
+def stage_timer():
+    t0 = time.time()                     # VIOLATION: time.time()
+    t1 = time.perf_counter()             # VIOLATION: time.perf_counter()
+    t2 = time.monotonic()                # VIOLATION: time.monotonic()
+    t3 = perf_counter()                  # VIOLATION: imported name
+    return t0, t1, t2, t3
+
+
+def not_timing():
+    time.sleep(0.0)  # not a clock read: never flagged
+    return time.strftime("%Y")  # nor formatting
+
+
+def deliberate_clock():
+    # graftlint: disable=timing-hygiene -- fixture: deliberate raw clock
+    return time.time()

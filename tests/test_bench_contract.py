@@ -15,23 +15,34 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
+
+import pytest
 
 REPO = os.path.join(os.path.dirname(__file__), os.pardir)
 REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data",
-            "assembly", "cache"}  # self-describing records (ADVICE r5 #1)
+            "assembly", "cache",  # self-describing records (ADVICE r5 #1)
+            "memory", "host_calib"}  # obsgraft: predicted-vs-observed HBM
+                                     # + host-calibration on EVERY record
 
 
 def run_bench(n, iters, extra_env=None, timeout=600):
     env = dict(os.environ, TSNE_FORCE_CPU="1", TSNE_BENCH_WRAPPED="1",
                # hermetic by default: no reads/writes of the repo-local
                # artifact root (the warm-cache case opts in via extra_env)
-               TSNE_ARTIFACTS="0")
+               TSNE_ARTIFACTS="0",
+               # ... and no writes to the repo-local results/ obs exports
+               # (the metrics round-trip case points these at a tmp dir)
+               TSNE_TRACE="0",
+               TSNE_METRICS_OUT=os.path.join(
+                   tempfile.gettempdir(), "tsne_bench_metrics_test.json"))
     # hermetic: ambient bench-driver knobs must not steer these cases
     # (each case pins its own deadline clock and knobs via extra_env)
     for knob in ("TSNE_BENCH_T0", "TSNE_BENCH_DEADLINE_S",
                  "TSNE_BENCH_MARGIN_S", "TSNE_BENCH_SEG",
                  "TSNE_ARTIFACT_DIR", "TSNE_AFFINITY_ASSEMBLY",
-                 "TSNE_TUNNEL_DOWN", "TSNE_KNN_AUTOTUNE"):
+                 "TSNE_TUNNEL_DOWN", "TSNE_KNN_AUTOTUNE",
+                 "TSNE_TELEMETRY"):
         env.pop(knob, None)
     env.update(extra_env or {})
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
@@ -128,6 +139,63 @@ def test_tunnel_down_fallback_is_explicitly_marked():
         assert rec["backend"] == "cpu"
     last = recs[-1]["last_tpu_record"]
     assert last is not None and os.path.exists(os.path.join(REPO, last))
+
+
+def test_record_carries_predicted_vs_observed_memory():
+    """obsgraft acceptance: every bench record carries the per-stage
+    observed memory watermark BESIDE graftcheck's predicted peak, with
+    the drift ratio that grades the static HBM model, plus the
+    host-calibration probe that makes cross-round stage ratios
+    normalizable (the r5-vs-r6 confound)."""
+    recs = run_bench(800, 20)
+    for rec in recs:
+        mem = rec["memory"]
+        assert mem["basis"] in ("rss", "device")
+        assert mem["predicted_peak"] > 0  # graftcheck's static estimate
+        hc = rec["host_calib"]
+        assert hc["matmul_gflops"] > 0
+        assert len(hc["signature"]) == 12  # cache.host_signature()
+    final = recs[-1]
+    stages = final["memory"]["stages"]
+    assert {"knn", "affinities", "optimize"} <= set(stages)
+    for st in ("knn", "affinities", "optimize"):
+        assert stages[st]["observed_bytes"] > 0
+        assert stages[st]["predicted_bytes"] > 0
+        assert stages[st]["drift"] == pytest.approx(
+            stages[st]["observed_bytes"] / stages[st]["predicted_bytes"],
+            rel=1e-2)
+    assert final["memory"]["observed_peak"] >= max(
+        s["observed_bytes"] for s in stages.values()) * 0.999
+
+
+def test_metrics_snapshot_round_trip_across_bench_subprocess(tmp_path):
+    """The metrics snapshot (obs/metrics.py) crosses the bench process
+    boundary intact: the sidecar JSON carries the snapshot schema, the
+    absorbed compile meter, and — with telemetry armed — the telemetry
+    gauges; the final stdout record embeds the same snapshot."""
+    mpath = tmp_path / "metrics.json"
+    tpath = tmp_path / "trace.json"
+    final = run_bench(800, 20, {"TSNE_METRICS_OUT": str(mpath),
+                                "TSNE_TRACE": str(tpath),
+                                "TSNE_TELEMETRY": "1"})[-1]
+    snap = json.loads(mpath.read_text())
+    for key in ("schema", "counters", "gauges", "histograms"):
+        assert key in snap
+    assert snap["counters"]["compile.count"] > 0  # absorbed meter
+    assert snap["gauges"]["memory.knn.observed_bytes"] > 0
+    assert snap["gauges"]["telemetry.grad_norm"] > 0
+    assert snap["run"]["n"] == 800
+    # the final record embeds a snapshot of the same schema + telemetry
+    assert final["metrics"]["schema"] == snap["schema"]
+    assert final["telemetry"]["grad_norm"] > 0
+    assert set(final["telemetry"]) == {"grad_norm", "gains_mean",
+                                       "gains_max", "y_min", "y_max"}
+    # ... and the trace sidecar is Perfetto-shaped with the span set the
+    # report tooling aggregates
+    trace = json.loads(tpath.read_text())
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"prepare.knn", "prepare.affinities", "optimize",
+            "optimize.segment", "host.calibrate"} <= names
 
 
 def test_warm_cache_run_is_labeled_and_fast(tmp_path):

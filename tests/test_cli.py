@@ -84,7 +84,9 @@ def test_parser_defaults_match_reference():
     # theta parses to None so main() can tell "defaulted 0.25" (Tsne.scala:59)
     # from "explicitly requested" — an explicit theta steers --repulsion auto
     assert a.theta is None
-    assert a.loss == "loss.txt"
+    # default routed under results/ (obsgraft satellite: run outputs must
+    # not litter the repo root)
+    assert a.loss == os.path.join("results", "loss.txt")
     # knnIterations parses to None -> pick_knn_rounds(n) (reference default 3
     # at small N; auto-grows with N for recall — Tsne.scala:61)
     assert a.knnIterations is None

@@ -60,7 +60,7 @@ def test_all_rules_registered():
     assert set(RULES) == {"env-registry", "jit-hygiene", "host-sync",
                           "dtype-drift", "bench-record-contract",
                           "cli-api-parity", "audit-contract",
-                          "exception-hygiene"}
+                          "exception-hygiene", "timing-hygiene"}
 
 
 # ---- every fixture violation is found, suppressions silence ---------------
@@ -74,6 +74,8 @@ FIXTURE_FOR_RULE = {
     "cli-api-parity": "fx_cli_parity.py",
     "audit-contract": os.path.join("ops", "fx_audit_contract.py"),
     "exception-hygiene": os.path.join("ops", "fx_exception_hygiene.py"),
+    "timing-hygiene": os.path.join("tsne_flink_tpu",
+                                   "fx_timing_hygiene.py"),
 }
 
 

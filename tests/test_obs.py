@@ -1,0 +1,380 @@
+"""obsgraft tier-1 contract (ISSUE 7 tentpole).
+
+Layers:
+
+* the TRACE SCHEMA is pinned: every recorded event carries EVENT_KEYS,
+  parent links form the span hierarchy, and the Chrome-trace export is
+  structurally what Perfetto loads (ph X/i, microsecond ts/dur);
+* the METRICS REGISTRY is typed and absorbs the compile meter / AOT
+  stats (utils/aot reads ARE registry reads);
+* telemetry/tracing OFF is bit-identical: a compiled optimize segment
+  with the obs layer present-but-disabled reproduces the untelemetered
+  program's outputs bit for bit, and with_telemetry=True changes ONLY
+  the extra output;
+* the memory watermark samples something real on this host and the
+  drift ratio closes the predicted-vs-observed loop;
+* scripts/trace_report.py --smoke round-trips an emitted trace (the
+  tooling satellite's tier-1 pin);
+* TSNE.fit populates trace_/metrics_ (and telemetry when asked).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tsne_flink_tpu.obs import memory as obmem
+from tsne_flink_tpu.obs import metrics as obmetrics
+from tsne_flink_tpu.obs import trace as obtrace
+
+pytestmark = pytest.mark.fast
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends with a quiet tracer/registry; the
+    enablement override never leaks between tests."""
+    obtrace.set_enabled(None)
+    obtrace.reset()
+    yield
+    obtrace.set_enabled(None)
+    obtrace.reset()
+
+
+# ---- trace schema ----------------------------------------------------------
+
+def test_span_records_schema_and_hierarchy():
+    obtrace.set_enabled(True)
+    with obtrace.span("parent", cat="stage", label="x") as sp:
+        with obtrace.span("child", cat="knn"):
+            pass
+        obtrace.instant("tick", cat="runtime", stage="knn")
+    events = obtrace.events()
+    assert [e["name"] for e in events] == ["child", "tick", "parent"]
+    for e in events:
+        assert set(obtrace.EVENT_KEYS) <= set(e), e
+    child, tick, parent = events
+    assert child["parent"] == parent["id"]
+    assert tick["parent"] == parent["id"]
+    assert parent["parent"] is None
+    assert parent["dur"] >= child["dur"] >= 0.0
+    assert tick["dur"] is None  # instants are zero-duration
+    assert parent["args"] == {"label": "x"}
+    assert sp.seconds == parent["dur"]
+
+
+def test_disabled_tracer_times_but_records_nothing():
+    assert not obtrace.enabled()
+    with obtrace.span("quiet") as sp:
+        pass
+    assert sp.seconds >= 0.0  # the span still IS the timer
+    assert obtrace.event_count() == 0
+
+
+def test_chrome_trace_export_is_perfetto_shaped(tmp_path):
+    obtrace.set_enabled(True)
+    with obtrace.span("stage", cat="prepare", cache="off"):
+        pass
+    obtrace.instant("evt", cat="runtime")
+    path = obtrace.write(str(tmp_path / "t.json"))
+    payload = json.loads(open(path).read())
+    assert "traceEvents" in payload
+    durs = [e for e in payload["traceEvents"] if e["ph"] == "X"]
+    inst = [e for e in payload["traceEvents"] if e["ph"] == "i"]
+    assert len(durs) == 1 and len(inst) == 1
+    x = durs[0]
+    assert {"name", "cat", "ts", "dur", "pid", "tid", "args"} <= set(x)
+    assert x["dur"] >= 0 and x["ts"] > 1e15  # microseconds since epoch
+    # JSONL twin carries the raw schema
+    jl = obtrace.write(str(tmp_path / "t.jsonl"))
+    lines = [json.loads(ln) for ln in open(jl) if ln.strip()]
+    assert len(lines) == 2
+    assert all(set(obtrace.EVENT_KEYS) <= set(e) for e in lines)
+
+
+def test_collecting_scope_records_without_global_enable():
+    assert not obtrace.enabled()
+    with obtrace.collecting():
+        assert obtrace.enabled()
+        with obtrace.span("in-scope"):
+            pass
+    assert not obtrace.enabled()
+    assert [e["name"] for e in obtrace.events()] == ["in-scope"]
+
+
+def test_env_trace_path_resolution(monkeypatch):
+    monkeypatch.delenv("TSNE_TRACE", raising=False)
+    assert obtrace.env_trace_path() is None
+    monkeypatch.setenv("TSNE_TRACE", "0")
+    assert obtrace.env_trace_path() is None
+    monkeypatch.setenv("TSNE_TRACE", "1")
+    assert obtrace.env_trace_path("d.json") == "d.json"
+    monkeypatch.setenv("TSNE_TRACE", "/tmp/x.jsonl")
+    assert obtrace.env_trace_path("d.json") == "/tmp/x.jsonl"
+
+
+# ---- metrics registry ------------------------------------------------------
+
+def test_metrics_typed_and_snapshot_schema():
+    obmetrics.counter("t.count").inc()
+    obmetrics.counter("t.count").inc(2.0)
+    obmetrics.gauge("t.gauge").set("warm")
+    h = obmetrics.histogram("t.hist")
+    h.observe(1.0)
+    h.observe(3.0)
+    with pytest.raises(TypeError, match="one name, one type"):
+        obmetrics.gauge("t.count")
+    snap = obmetrics.snapshot()
+    assert set(obmetrics.SNAPSHOT_KEYS) <= set(snap)
+    assert snap["schema"] == obmetrics.SCHEMA_VERSION
+    assert snap["counters"]["t.count"] == 3  # integral values stay ints
+    assert snap["gauges"]["t.gauge"] == "warm"
+    assert snap["histograms"]["t.hist"] == {
+        "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0, "mean": 2.0}
+
+
+def test_write_snapshot_round_trip(tmp_path):
+    obmetrics.counter("rt.c").inc(5)
+    path = obmetrics.write_snapshot(str(tmp_path / "m.json"),
+                                    extra={"run": {"n": 7}})
+    got = json.loads(open(path).read())
+    assert got["counters"]["rt.c"] == 5
+    assert got["run"] == {"n": 7}
+    assert set(obmetrics.SNAPSHOT_KEYS) <= set(got)
+
+
+def test_aot_stats_are_registry_reads():
+    """utils/aot absorbed into obs/metrics: its compile meter and
+    hit/miss stats read the `compile.*` / `aot.*` counters."""
+    from tsne_flink_tpu.utils import aot
+    base = aot.compile_snapshot()
+    obmetrics.counter("compile.count").inc()
+    obmetrics.counter("compile.seconds").inc(0.25)
+    now = aot.compile_snapshot()
+    assert now["count"] == base["count"] + 1
+    assert now["seconds"] == pytest.approx(base["seconds"] + 0.25)
+    s0 = aot.stats()
+    obmetrics.counter("aot.hits").inc()
+    assert aot.stats()["hits"] == s0["hits"] + 1
+
+
+# ---- memory watermark ------------------------------------------------------
+
+def test_memory_sample_and_drift():
+    peak, basis = obmem.observed_peak_bytes()
+    assert basis in ("rss", "device")
+    assert peak > 0  # this process is certainly resident
+    rec = obmem.sample("teststage")
+    assert rec["observed_bytes"] == pytest.approx(peak, rel=0.5)
+    snap = obmetrics.snapshot()
+    assert snap["gauges"]["memory.teststage.observed_bytes"] > 0
+    assert obmem.drift(150, 100) == 1.5
+    assert obmem.drift(100, 0) is None
+    assert obmem.drift(100, None) is None
+
+
+# ---- telemetry / tracer off = bit-identical --------------------------------
+
+def _tiny_problem(n=32, s=12, iters=30):
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneConfig, init_working_set
+    rng = np.random.default_rng(3)
+    jidx = jnp.asarray(rng.integers(0, n, (n, s)), jnp.int32)
+    jval = jnp.asarray(rng.random((n, s)), jnp.float32) / (n * s)
+    cfg = TsneConfig(iterations=iters, repulsion="exact")
+    st = init_working_set(jax.random.key(0), n, 2, jnp.float32)
+    return cfg, st, jidx, jval
+
+
+def test_telemetry_off_is_bit_identical_compiled_segment():
+    """The acceptance pin: with the obs layer present and telemetry/
+    tracing DISABLED, a compiled optimize segment reproduces the same
+    bits as with tracing enabled — and with_telemetry=True changes ONLY
+    the extra output, not the state or losses."""
+    from functools import partial
+
+    import jax
+
+    from tsne_flink_tpu.models.tsne import TELEMETRY_FIELDS, optimize
+    cfg, st, jidx, jval = _tiny_problem()
+    base_fn = jax.jit(partial(optimize, cfg=cfg, num_iters=30))
+    ref_state, ref_losses = base_fn(st, jidx, jval, start_iter=0)
+    jax.block_until_ready(ref_state.y)
+    # tracer enabled around the SAME compiled segment: identical bits
+    obtrace.set_enabled(True)
+    with obtrace.span("optimize", cat="stage"):
+        got_state, got_losses = base_fn(st, jidx, jval, start_iter=0)
+    np.testing.assert_array_equal(np.asarray(got_state.y),
+                                  np.asarray(ref_state.y))
+    np.testing.assert_array_equal(np.asarray(got_losses),
+                                  np.asarray(ref_losses))
+    obtrace.set_enabled(None)
+    # telemetry armed: state/losses stay bit-identical, telemetry appears
+    tel_fn = jax.jit(partial(optimize, cfg=cfg, num_iters=30,
+                             with_telemetry=True))
+    t_state, t_losses, tel = tel_fn(st, jidx, jval, start_iter=0)
+    np.testing.assert_array_equal(np.asarray(t_state.y),
+                                  np.asarray(ref_state.y))
+    np.testing.assert_array_equal(np.asarray(t_losses),
+                                  np.asarray(ref_losses))
+    tel = np.asarray(tel)
+    assert tel.shape == (cfg.n_loss_slots, len(TELEMETRY_FIELDS))
+    assert np.isfinite(tel).all()
+    assert (tel[:, 0] > 0).all()       # grad_norm
+    assert (tel[:, 2] >= tel[:, 1]).all()  # gains_max >= gains_mean
+    assert (tel[:, 4] > tel[:, 3]).all()   # y_max > y_min
+
+
+def test_segmented_telemetry_matches_full_run():
+    """Telemetry slots key off the absolute iteration like the loss
+    trace, so a segmented run fills the identical trace."""
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    cfg, st, jidx, jval = _tiny_problem()
+    r_full = ShardedOptimizer(cfg, 32, n_devices=1)
+    s_full, _ = r_full(st, jidx, jval, telemetry=True)
+    r_seg = ShardedOptimizer(cfg, 32, n_devices=1)
+    s_seg, _ = r_seg(st, jidx, jval, telemetry=True, checkpoint_every=10,
+                     checkpoint_cb=lambda *a: None)
+    np.testing.assert_array_equal(np.asarray(s_full.y),
+                                  np.asarray(s_seg.y))
+    np.testing.assert_array_equal(r_full.telemetry_, r_seg.telemetry_)
+
+
+def test_sharded_segments_emit_spans():
+    from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+    cfg, st, jidx, jval = _tiny_problem()
+    obtrace.set_enabled(True)
+    r = ShardedOptimizer(cfg, 32, n_devices=1)
+    r(st, jidx, jval, checkpoint_every=10, checkpoint_cb=lambda *a: None)
+    segs = [e for e in obtrace.events()
+            if e["name"] == "optimize.segment"]
+    assert len(segs) == 3
+    assert [s["args"]["start_iter"] for s in segs] == [0, 10, 20]
+
+
+# ---- prepare stage spans + memory -----------------------------------------
+
+def test_prepare_emits_stage_spans_and_memory():
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.utils.artifacts import prepare
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((64, 8)), jnp.float32)
+    obtrace.set_enabled(True)
+    prep = prepare(x, neighbors=8, knn_method="bruteforce",
+                   key=jax.random.key(0), perplexity=4.0)
+    names = [e["name"] for e in obtrace.events()]
+    assert "prepare.knn" in names and "prepare.affinities" in names
+    # the span IS the stage timer
+    knn_span = next(e for e in obtrace.events()
+                    if e["name"] == "prepare.knn")
+    assert knn_span["dur"] == pytest.approx(prep.knn_seconds)
+    assert prep.memory["knn"]["observed_bytes"] > 0
+    assert prep.memory["affinities"]["basis"] in ("rss", "device")
+
+
+# ---- estimator surface -----------------------------------------------------
+
+def test_tsne_fit_populates_trace_and_metrics():
+    from tsne_flink_tpu.models.api import TSNE
+    rng = np.random.default_rng(0)
+    x = (rng.random((80, 6)) * 4).astype(np.float32)
+    t = TSNE(n_iter=30, perplexity=4.0, neighbors=8, telemetry=True)
+    t.fit(x)
+    assert t.trace_, "fit recorded no spans"
+    names = {e["name"] for e in t.trace_}
+    assert "prepare.knn" in names
+    assert "optimize.segment" in names
+    assert set(obmetrics.SNAPSHOT_KEYS) <= set(t.metrics_)
+    tel = t.metrics_["telemetry"]
+    assert tel["fields"][0] == "grad_norm"
+    assert len(tel["trace"]) == 3  # 30 iters / LOSS_EVERY
+    assert all(np.isfinite(v) for row in tel["trace"] for v in row)
+
+
+def test_tsne_fit_without_telemetry_has_no_telemetry_key():
+    from tsne_flink_tpu.models.api import TSNE
+    rng = np.random.default_rng(1)
+    x = (rng.random((60, 5)) * 4).astype(np.float32)
+    t = TSNE(n_iter=20, perplexity=4.0, neighbors=6)
+    t.fit(x)
+    assert "telemetry" not in t.metrics_
+    assert t.trace_  # spans still collected for the fit
+
+
+# ---- CLI surface -----------------------------------------------------------
+
+def test_cli_trace_and_metrics_outputs(tmp_path):
+    from tests.test_cli import blob_csv
+    from tsne_flink_tpu.utils.cli import main
+    tmp = str(tmp_path)
+    path, _ = blob_csv(tmp, n=40, d=6)
+    out = os.path.join(tmp, "out.csv")
+    tr = os.path.join(tmp, "trace.json")
+    mx = os.path.join(tmp, "metrics.json")
+    rc = main(["--input", path, "--output", out, "--dimension", "6",
+               "--knnMethod", "bruteforce", "--perplexity", "4",
+               "--iterations", "20", "--noCache",
+               "--loss", os.path.join(tmp, "l.txt"),
+               "--trace", tr, "--metricsOut", mx, "--telemetry"])
+    assert rc == 0
+    payload = json.loads(open(tr).read())
+    names = {e["name"] for e in payload["traceEvents"]}
+    assert {"cli.run", "prepare.knn", "prepare.affinities",
+            "optimize.segment"} <= names
+    snap = json.loads(open(mx).read())
+    assert set(obmetrics.SNAPSHOT_KEYS) <= set(snap)
+    assert "telemetry.grad_norm" in snap["gauges"]
+    # the tracer enablement did not leak out of main()
+    assert obtrace.enabled_override() is None
+
+
+# ---- trace_report tooling --------------------------------------------------
+
+def test_trace_report_smoke_subprocess():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         "--smoke", "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-1000:] + r.stderr[-1000:]
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is True
+    assert payload["summary"]["spans"]["optimize.segment"]["count"] == 2
+
+
+def test_trace_report_on_real_trace(tmp_path):
+    obtrace.set_enabled(True)
+    with obtrace.span("prepare.knn", cat="prepare"):
+        pass
+    with obtrace.span("optimize.segment", cat="optimize", seg=1,
+                      start_iter=0, num_iters=50):
+        pass
+    path = obtrace.write(str(tmp_path / "t.json"))
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import trace_report
+        summary = trace_report.summarize(trace_report.load_events(path))
+    finally:
+        sys.path.pop(0)
+    assert summary["spans"]["prepare.knn"]["count"] == 1
+    assert summary["segments"][0]["num_iters"] == 50
+
+
+# ---- obs stays stdlib-importable ------------------------------------------
+
+def test_trace_and_metrics_import_without_jax():
+    code = ("import sys\n"
+            "import tsne_flink_tpu.obs.trace\n"
+            "import tsne_flink_tpu.obs.metrics\n"
+            "import tsne_flink_tpu.obs.memory\n"
+            "assert not any(m == 'jax' or m.startswith('jax.') "
+            "for m in sys.modules), 'obs pulled in jax'\n")
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=REPO)

@@ -30,6 +30,12 @@ once (ADVICE/VERDICT rounds 1-5); the linter catches it forever:
   log) hides real failures from the recovery machinery (the supervisor
   can only ladder an OOM it sees); such handlers must re-raise, log, or
   carry a rationale'd suppression.
+* ``timing-hygiene``    — raw wall clocks (``time.time`` /
+  ``time.perf_counter`` / ``time.monotonic``) inside ``tsne_flink_tpu/``
+  outside ``obs/``: timing must flow through obs spans (``obs/trace.py``)
+  so every measured second lands in the trace/metrics schema instead of
+  a private variable — the pre-obsgraft world where bench.py was the
+  only timed entry point.
 
 Rules are pure-AST project passes registered with :func:`core.rule`; they
 never import the code under analysis.
@@ -361,7 +367,8 @@ def jit_hygiene(project: Project):
 TSNE_HOT_FUNCS = {
     "optimize", "_gradient", "_attractive_forces",
     "_attractive_forces_edges", "_update_embedding", "_center",
-    "_global_mean", "_psum", "center_input",
+    "_global_mean", "_psum", "_pmax", "_pmin", "_telemetry_row",
+    "center_input",
 }
 
 _SYNC_NUMPY_FUNCS = ("asarray", "array")
@@ -587,6 +594,11 @@ CLI_ONLY_FLAGS = {
     # testing knob, not a model hyper-parameter; in-process callers use
     # runtime.faults.activate() / $TSNE_FAULT_PLAN directly
     "faultPlan",
+    # obs file outputs (obs/trace.py / obs/metrics.py): run artifacts of
+    # a CLI invocation; the estimator exposes the same data in-process as
+    # TSNE.trace_ / TSNE.metrics_ instead of writing files unasked
+    # (--telemetry DOES have the kwarg twin TSNE(telemetry=))
+    "trace", "metricsOut",
 }
 
 #: estimator-only kwargs with no CLI counterpart (none at present; the
@@ -855,4 +867,54 @@ def audit_contract(project: Project):
                     "contract: add a contract(...) entry to "
                     "tsne_flink_tpu/analysis/audit/contracts.py so the "
                     "dtype-contract auditor covers it"))
+    return findings
+
+
+# ---- rule: timing-hygiene --------------------------------------------------
+
+#: time-module attributes whose call is a raw wall-clock read (sleep,
+#: strftime etc. are not timing and never flagged)
+_CLOCK_ATTRS = ("time", "perf_counter", "perf_counter_ns", "monotonic",
+                "monotonic_ns")
+
+
+@rule("timing-hygiene",
+      "raw time.time/perf_counter/monotonic inside tsne_flink_tpu/ "
+      "(outside obs/) — timing must flow through obs spans")
+def timing_hygiene(project: Project):
+    findings = []
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        # package scope only: bench.py keeps its window-proofing deadline
+        # clock and the standalone profiler scripts their measurement
+        # loops; obs/ is where the clocks legitimately live
+        if not ("tsne_flink_tpu/" in norm
+                or norm.startswith("tsne_flink_tpu")):
+            continue
+        if "/obs/" in norm or "tsne_flink_tpu/obs" in norm:
+            continue
+        time_mods = _import_aliases(mod.tree, "time")
+        from_names = set()
+        for attr in _CLOCK_ATTRS:
+            from_names |= _from_import_aliases(mod.tree, attr)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            what = None
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _CLOCK_ATTRS
+                    and _is_name_in(func.value, time_mods)):
+                what = f"time.{func.attr}()"
+            elif isinstance(func, ast.Name) and func.id in from_names:
+                what = f"{func.id}()"
+            if what is None:
+                continue
+            findings.append(mod.finding(
+                "timing-hygiene", node,
+                f"raw clock {what} inside the package: timing must flow "
+                "through obs spans (tsne_flink_tpu/obs/trace.py — "
+                "`with trace.span(...) as sp:` then sp.seconds) so the "
+                "measurement lands in the trace/metrics schema; suppress "
+                "with the rationale if a raw clock is genuinely required"))
     return findings

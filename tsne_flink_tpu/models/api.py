@@ -43,7 +43,8 @@ class TSNE:
                  cache_dir: str | None = None,
                  max_retries: int = 2, on_oom: str = "ladder",
                  health_check: bool = False,
-                 aot_cache: bool | None = None):
+                 aot_cache: bool | None = None,
+                 telemetry: bool = False):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -135,11 +136,22 @@ class TSNE:
         # for this fit, None defers to $TSNE_AOT_CACHE.  A LIBRARY caller
         # who wants disk persistence opts in explicitly, like cache_dir.
         self.aot_cache = aot_cache
+        # device-side in-loop telemetry (the CLI's --telemetry): grad-norm,
+        # gains mean/max and the embedding bbox ride the optimize loop
+        # carry at the KL report interval (obs; zero in-segment host
+        # syncs).  Routes the fit through the segmented supervised path —
+        # telemetry needs segment boundaries to be read at; off keeps the
+        # unsupervised fast path bit-identical.
+        self.telemetry = telemetry
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
         self.runtime_events_ = None
         self.degradations_ = None
+        # obs results (tsne_flink_tpu/obs/): the spans recorded during the
+        # last fit and one metrics snapshot taken at its end
+        self.trace_ = None
+        self.metrics_ = None
 
     def _config(self, n: int) -> TsneConfig:
         from tsne_flink_tpu.utils.cli import pick_repulsion
@@ -213,6 +225,26 @@ class TSNE:
         return self._fit_inner(x)
 
     def _fit_inner(self, x) -> "TSNE":
+        from tsne_flink_tpu.obs import metrics as obmetrics
+        from tsne_flink_tpu.obs import trace as obtrace
+
+        # collect spans for this fit without flipping process-global
+        # tracing state; trace_ gets exactly the fit's events
+        self._last_telemetry = None
+        i0 = obtrace.event_count()
+        with obtrace.collecting():
+            out = self._fit_body(x)
+        self.trace_ = obtrace.events_since(i0)
+        self.metrics_ = obmetrics.snapshot()
+        tel = getattr(self, "_last_telemetry", None)
+        if tel is not None:
+            from tsne_flink_tpu.models.tsne import TELEMETRY_FIELDS
+            self.metrics_["telemetry"] = {
+                "fields": list(TELEMETRY_FIELDS),
+                "trace": np.asarray(tel).tolist()}
+        return out
+
+    def _fit_body(self, x) -> "TSNE":
         import jax
 
         cfg = self._config(x.shape[0])
@@ -239,18 +271,22 @@ class TSNE:
                                 sym_strict=self.sym_strict,
                                 n_devices=self.devices,
                                 artifact_cache=cache)
-            if ((cache is not None or self.health_check)
+            if ((cache is not None or self.health_check or self.telemetry)
                     and jax.process_count() == 1):
                 # the segmented prepare+optimize form (same results as the
                 # fused program) is the one whose prepare() half the
                 # artifact cache can skip — and the one whose segment
-                # boundaries the divergence sentinel rolls back to
+                # boundaries the divergence sentinel (and the telemetry
+                # read) roll back to / fire at
                 self.runtime_events_ = []
                 state, losses = pipe.run_checkpointable(
                     x, jax.random.key(self.random_state),
                     health_check=self.health_check,
-                    events=self.runtime_events_)
+                    events=self.runtime_events_,
+                    telemetry=self.telemetry)
                 y = state.y
+                self._last_telemetry = getattr(pipe._runner, "telemetry_",
+                                               None)
             else:
                 y, losses = pipe(x, jax.random.key(self.random_state))
             if jax.process_count() > 1:
@@ -284,11 +320,15 @@ class TSNE:
                 sym_width=self.sym_width,
                 affinity_assembly=self.affinity_assembly,
                 artifact_cache=self._artifact_cache())
-            if self.health_check or faults.injector() is not None:
+            if (self.health_check or self.telemetry
+                    or faults.injector() is not None):
                 # supervised segmented path: the sentinel (and fault
-                # injection) need segment boundaries to roll back to
+                # injection, and the telemetry boundary reads) need
+                # segment boundaries
                 y, losses = supervised_embed(x, cfg, supervisor=sup,
+                                             telemetry=self.telemetry,
                                              **embed_kwargs)
+                self._last_telemetry = sup.last_telemetry
             else:
                 try:
                     # the unsupervised fast path is byte-for-byte the

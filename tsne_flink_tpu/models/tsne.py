@@ -45,6 +45,12 @@ LOSS_EVERY = 10  # TsneHelpers.scala:297
 REPULSION_BACKENDS = ("exact", "bh", "fft")  # _gradient dispatch
 REPULSION_CHOICES = ("auto",) + REPULSION_BACKENDS  # CLI / bench / api
 
+#: columns of the in-loop telemetry trace (``optimize(with_telemetry=
+#: True)``): one row per KL report slot, recorded on-device in the same
+#: fori_loop carry as the loss trace — zero extra host syncs in-segment.
+TELEMETRY_FIELDS = ("grad_norm", "gains_mean", "gains_max", "y_min",
+                    "y_max")
+
 
 @dataclass(frozen=True)
 class TsneConfig:
@@ -108,6 +114,40 @@ def init_working_set(key: jax.Array, n: int, n_components: int = 2,
 
 def _psum(x, axis_name):
     return x if axis_name is None else lax.psum(x, axis_name)
+
+
+def _pmax(x, axis_name):
+    return x if axis_name is None else lax.pmax(x, axis_name)
+
+
+def _pmin(x, axis_name):
+    return x if axis_name is None else lax.pmin(x, axis_name)
+
+
+def _telemetry_row(st: "TsneState", grad, axis_name, valid):
+    """One :data:`TELEMETRY_FIELDS` row from the post-update state: global
+    grad L2 norm, gains mean/max, embedding bbox — every value is a global
+    scalar (psum/pmin/pmax over the mesh), so the row is replication-
+    invariant like the loss trace.  ``grad`` is already masked to valid
+    rows; padded gains/y rows are masked here."""
+    dt = st.y.dtype
+    gn2 = _psum(jnp.sum(grad * grad), axis_name)
+    if valid is None:
+        gsum = _psum(jnp.sum(st.gains), axis_name)
+        gcnt = _psum(jnp.asarray(st.gains.size, dt), axis_name)
+        gmax = _pmax(jnp.max(st.gains), axis_name)
+        ymin = _pmin(jnp.min(st.y), axis_name)
+        ymax = _pmax(jnp.max(st.y), axis_name)
+    else:
+        vm = valid[:, None]
+        w = valid.astype(dt)
+        gsum = _psum(jnp.sum(st.gains * w[:, None]), axis_name)
+        gcnt = _psum(jnp.sum(w), axis_name) * st.gains.shape[1]
+        gmax = _pmax(jnp.max(jnp.where(vm, st.gains, -jnp.inf)), axis_name)
+        ymin = _pmin(jnp.min(jnp.where(vm, st.y, jnp.inf)), axis_name)
+        ymax = _pmax(jnp.max(jnp.where(vm, st.y, -jnp.inf)), axis_name)
+    return jnp.stack([jnp.sqrt(gn2), gsum / gcnt, gmax, ymin,
+                      ymax]).astype(dt)
 
 
 def _attractive_forces(y_local, y_full, jidx, jval, exag, z,
@@ -278,7 +318,8 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
              axis_name=None, row_offset=0, valid=None,
              start_iter=0, num_iters: int | None = None,
              loss_carry=None, edges=None, edges_extra=False,
-             with_health=False):
+             with_health=False, with_telemetry=False,
+             telemetry_carry=None):
     """Full 3-phase gradient descent as ONE compiled fori_loop.
 
     Returns (final TsneState, loss trace [iterations // 10]); trace slot t is
@@ -299,6 +340,15 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
     output the segment runner reads once per boundary
     (``runtime/health.py`` holds the rollback policy).  With the default
     ``False`` the program is unchanged, bit for bit.
+
+    ``with_telemetry`` (static) arms the in-loop telemetry trace, the
+    same contract: a ``[n_loss_slots, len(TELEMETRY_FIELDS)]`` array
+    (grad-norm, gains mean/max, embedding bbox) rides the carry and is
+    written at the KL report interval, keyed off the absolute iteration
+    exactly like the loss slots (so segmented runs fill it identically
+    to one full run; ``telemetry_carry`` threads it between segments).
+    It is returned AFTER the losses (and before the health flag); off =
+    today's program, bit for bit (pinned by tests/test_obs.py).
     """
     m0 = jnp.asarray(cfg.initial_momentum, state.y.dtype)
     m1 = jnp.asarray(cfg.final_momentum, state.y.dtype)
@@ -311,10 +361,9 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
                   else lax.all_gather(valid, axis_name, tiled=True))
 
     def body(i, carry):
-        if with_health:
-            st, loss_arr, ok = carry
-        else:
-            st, loss_arr = carry
+        st, loss_arr = carry[0], carry[1]
+        tel_arr = carry[2] if with_telemetry else None
+        ok = carry[-1] if with_health else None
         momentum = jnp.where(i < cfg.momentum_switch, m0, m1)
         exag = jnp.where(i < cfg.exaggeration_end, alpha, one)
         grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
@@ -329,27 +378,44 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
         record = (i + 1) % LOSS_EVERY == 0
         loss_arr = loss_arr.at[slot].set(
             jnp.where(record, loss, loss_arr[slot]))
+        out = [st, loss_arr]
+        if with_telemetry:
+            # telemetry rides the carry like the loss trace: same slot
+            # keying, written only at the report interval
+            row = _telemetry_row(st, grad, axis_name, valid)
+            tel_arr = tel_arr.at[slot].set(
+                jnp.where(record, row, tel_arr[slot]))
+            out.append(tel_arr)
         if with_health:
             # divergence sentinel: the shard-local finite check rides the
             # carry (loss is already globally psum'd by _gradient)
             ok = (ok & jnp.all(jnp.isfinite(st.y))
                   & jnp.all(jnp.isfinite(st.gains)) & jnp.isfinite(loss))
-            return st, loss_arr, ok
-        return st, loss_arr
+            out.append(ok)
+        return tuple(out)
 
     loss0 = (loss_carry if loss_carry is not None
              else jnp.zeros((n_slots,), state.y.dtype))
     num = cfg.iterations if num_iters is None else num_iters
     start = jnp.asarray(start_iter, jnp.int32)
+    init = [state, loss0]
+    if with_telemetry:
+        init.append(telemetry_carry if telemetry_carry is not None
+                    else jnp.zeros((n_slots, len(TELEMETRY_FIELDS)),
+                                   state.y.dtype))
     if with_health:
-        state, losses, ok = lax.fori_loop(
-            start, start + num, body, (state, loss0, jnp.asarray(True)))
+        init.append(jnp.asarray(True))
+    out = lax.fori_loop(start, start + num, body, tuple(init))
+    state, losses = out[0], out[1]
+    res = [state, losses]
+    if with_telemetry:
+        res.append(out[2])
+    if with_health:
         # one scalar collective AFTER the loop makes the flag global (and
         # replication-invariant under shard_map out_specs P())
-        bad = _psum((~ok).astype(jnp.int32), axis_name)
-        return state, losses, bad == 0
-    state, losses = lax.fori_loop(start, start + num, body, (state, loss0))
-    return state, losses
+        bad = _psum((~out[-1]).astype(jnp.int32), axis_name)
+        res.append(bad == 0)
+    return tuple(res)
 
 
 def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,

@@ -1,0 +1,35 @@
+"""obsgraft — the unified tracing + metrics layer.
+
+One subsystem is the single timing/telemetry source of truth for the whole
+pipeline (the reference's only observability feature — the per-iteration
+KL-loss accumulator pushed through ``MapAccumulator.java:27`` /
+``Tsne.scala:99-101`` — generalized to every stage):
+
+* :mod:`tsne_flink_tpu.obs.trace` — hierarchical span tracer.  Spans wrap
+  prepare stages, kNN substages, optimize segments, AOT load/compile and
+  supervisor recovery steps; exported as Chrome-trace JSON (Perfetto /
+  chrome://tracing loadable) and a structured JSONL event log.  Timing
+  inside ``tsne_flink_tpu/`` flows through spans — the graftlint
+  ``timing-hygiene`` rule makes a raw ``time.time()``/``perf_counter()``
+  outside this package a finding.
+* :mod:`tsne_flink_tpu.obs.metrics` — typed counter/gauge/histogram
+  registry absorbing the compile meter, AOT hit/miss stats and runtime
+  recovery counters into ONE snapshot schema, consumed by bench records,
+  ``TSNE.metrics_`` and the CLI's ``--metricsOut``.
+* :mod:`tsne_flink_tpu.obs.memory` — per-stage observed memory watermark
+  (JAX device memory stats on TPU, RSS fallback on CPU), recorded beside
+  graftcheck's predicted per-stage peak as a predicted-vs-observed drift
+  ratio on every bench record.
+* :mod:`tsne_flink_tpu.obs.calibrate` — the host-calibration probe: a
+  short measured matmul GFLOP/s sample + ``cache.host_signature()`` so
+  cross-round stage ratios are normalizable after the fact (the r5-vs-r6
+  host-speed confound).
+
+``trace`` and ``metrics`` are pure stdlib (importable without JAX, like
+``utils/env.py``); ``memory`` and ``calibrate`` import JAX lazily inside
+their functions.
+"""
+
+from tsne_flink_tpu.obs import metrics, trace  # noqa: F401
+
+__all__ = ["trace", "metrics"]

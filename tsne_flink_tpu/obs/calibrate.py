@@ -1,0 +1,59 @@
+"""Host-calibration probe: a measured matmul GFLOP/s sample per process.
+
+Round 6's bench host ran identical code 1.7-3x slower than round 5's
+(affinities 16.9 s vs 9.8 s, optimize 1.25 vs 0.42 s/iter), and nothing in
+the records said so — cross-round totals were silently incomparable.  This
+probe runs a short jitted f32 matmul loop once per process and records
+(measured GFLOP/s, ``cache.host_signature()``) on every bench record, so a
+future reader can normalize stage ratios across rounds: two records with
+the same signature ran on interchangeable hosts; different signatures are
+compared via the measured rate, not assumed equal.
+
+The number is a CALIBRATION sample, not a hardware claim: one shape, a few
+reps, seconds-scale.  It rides the ``host.matmul_gflops`` gauge and the
+``host_calib`` bench-record key.
+"""
+
+from __future__ import annotations
+
+from tsne_flink_tpu.obs import metrics, trace
+
+#: probe shape/reps: 2 * 768^3 * 3 ≈ 2.7 GFLOP — sub-second on any host
+#: that can run the bench at all, large enough to hide dispatch overhead.
+PROBE_SIZE = 768
+PROBE_REPS = 3
+
+_CACHED: dict | None = None
+
+
+def host_calibration(size: int = PROBE_SIZE, reps: int = PROBE_REPS) -> dict:
+    """``{"signature", "matmul_gflops", "backend", "size", "reps"}`` —
+    measured once per process (later calls return the cached sample)."""
+    global _CACHED
+    if _CACHED is not None:
+        return dict(_CACHED)
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.utils.cache import host_signature
+
+    key = jax.random.key(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (size, size), jnp.float32)
+    b = jax.random.normal(kb, (size, size), jnp.float32)
+    f = jax.jit(lambda x, y: x @ y)
+    f(a, b).block_until_ready()  # compile + warm outside the measurement
+    with trace.span("host.calibrate", cat="calibrate",
+                    size=size, reps=reps) as sp:
+        out = a
+        for _ in range(max(1, reps)):
+            out = f(out, b)
+        out.block_until_ready()
+    gflops = 2.0 * size ** 3 * max(1, reps) / max(sp.seconds, 1e-9) / 1e9
+    _CACHED = {"signature": host_signature(),
+               "matmul_gflops": round(gflops, 2),
+               "backend": jax.default_backend(),
+               "size": int(size), "reps": int(max(1, reps))}
+    metrics.gauge("host.matmul_gflops").set(_CACHED["matmul_gflops"])
+    metrics.gauge("host.signature").set(_CACHED["signature"])
+    return dict(_CACHED)

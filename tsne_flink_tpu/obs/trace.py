@@ -1,0 +1,303 @@
+"""Hierarchical span tracer — the process-global timing source of truth.
+
+A :class:`Span` ALWAYS measures (one ``perf_counter`` pair), so callers can
+use ``sp.seconds`` as their stage timing whether or not tracing is enabled;
+the finished event is appended to the process buffer only when tracing is
+on.  That split is the whole design: the pipeline's timing flows through
+spans unconditionally (``PrepareResult.knn_seconds`` IS a span duration),
+while the recording cost is zero until someone asks for a trace.
+
+Enablement: ``$TSNE_TRACE`` (a path, or 1/true for the default path), the
+CLI's ``--trace[=path]`` via :func:`set_enabled`, or a nestable
+:func:`collecting` scope (``TSNE.fit`` uses it to populate ``trace_``
+without touching process state).
+
+Export formats:
+
+* :func:`write_chrome_trace` — Chrome trace event format (``traceEvents``
+  with ``ph: "X"`` duration events and ``ph: "i"`` instants), loadable in
+  Perfetto (https://ui.perfetto.dev) or chrome://tracing.  Nesting is by
+  time on one track, so the span hierarchy renders as a flame graph.
+* :func:`write_jsonl` — one JSON event per line with explicit
+  ``id``/``parent`` links (the machine-diffable form; scripts/
+  trace_report.py consumes either).
+
+Pure stdlib by design (the graftlint env-table/analyzer environments have
+no JAX); thread-safe (per-thread span stacks, one buffer lock).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from tsne_flink_tpu.utils.env import env_bool, env_str
+
+#: keys every exported span/instant event carries (the trace-schema
+#: contract, pinned by tests/test_obs.py).  ``dur`` is None for instants.
+EVENT_KEYS = ("id", "parent", "name", "cat", "ts", "dur", "pid", "tid",
+              "args")
+
+#: buffer hard cap: events beyond it are counted in ``dropped_events()``
+#: instead of stored, so a pathological span loop cannot eat the host.
+MAX_EVENTS = 200_000
+
+_LOCK = threading.Lock()
+_EVENTS: list[dict] = []
+_DROPPED = 0
+_NEXT_ID = [1]
+_TLS = threading.local()
+
+_ENABLED_OVERRIDE: bool | None = None
+_COLLECT_DEPTH = 0
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def set_enabled(value: bool | None) -> None:
+    """Process override for the tracer: True/False force it, None defers
+    to ``$TSNE_TRACE`` (the CLI's ``--trace`` / bench.py set True)."""
+    global _ENABLED_OVERRIDE
+    _ENABLED_OVERRIDE = value
+
+
+def enabled_override() -> bool | None:
+    """The current process override (callers that save/restore it around
+    a run, like cli.main — same contract as aot.enabled_override)."""
+    return _ENABLED_OVERRIDE
+
+
+def enabled() -> bool:
+    if _COLLECT_DEPTH > 0:
+        return True
+    if _ENABLED_OVERRIDE is not None:
+        return _ENABLED_OVERRIDE
+    return env_bool("TSNE_TRACE", default=False)
+
+
+class collecting:
+    """Nestable scope that turns recording on for its duration —
+    ``TSNE.fit`` wraps itself in one so ``trace_`` is populated without
+    flipping process-global state for other callers."""
+
+    def __enter__(self):
+        global _COLLECT_DEPTH
+        _COLLECT_DEPTH += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _COLLECT_DEPTH
+        _COLLECT_DEPTH -= 1
+        return False
+
+
+def env_trace_path(default: str = os.path.join("results", "trace.json")):
+    """The trace output path ``$TSNE_TRACE`` asks for: None when tracing
+    is off, ``default`` for bare enablement (1/true), else the value
+    itself (a path)."""
+    raw = env_str("TSNE_TRACE", default=None)
+    if not raw or raw.lower() in ("0", "false", "no", "off"):
+        return None
+    if raw.lower() in ("1", "true", "yes", "on"):
+        return default
+    return raw
+
+
+class Span:
+    """One timed region.  Use as a context manager (``with span(...) as
+    sp:``) or manually via :func:`begin` / :meth:`end`."""
+
+    __slots__ = ("name", "cat", "args", "sid", "parent", "ts", "dur", "_t0")
+
+    def __init__(self, name: str, cat: str, args: dict):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.sid = None
+        self.parent = None
+        self.ts = None
+        self.dur = None
+        self._t0 = None
+
+    def start(self) -> "Span":
+        with _LOCK:
+            self.sid = _NEXT_ID[0]
+            _NEXT_ID[0] += 1
+        stack = _stack()
+        self.parent = stack[-1].sid if stack else None
+        stack.append(self)
+        self.ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def elapsed(self) -> float:
+        """Seconds since start — live while open, final after end()."""
+        if self.dur is not None:
+            return self.dur
+        return time.perf_counter() - self._t0
+
+    @property
+    def seconds(self) -> float:
+        return self.elapsed()
+
+    def set(self, **args) -> "Span":
+        """Attach/overwrite args (resolved labels known only at the end)."""
+        self.args.update(args)
+        return self
+
+    def end(self) -> "Span":
+        if self.dur is not None:
+            return self  # idempotent
+        self.dur = time.perf_counter() - self._t0
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:  # out-of-order end: keep the stack consistent
+            stack.remove(self)
+        if enabled():
+            _append(self.as_dict())
+        return self
+
+    def as_dict(self) -> dict:
+        return {"id": self.sid, "parent": self.parent, "name": self.name,
+                "cat": self.cat, "ts": self.ts, "dur": self.dur,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "args": dict(self.args)}
+
+    def __enter__(self) -> "Span":
+        if self._t0 is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def span(name: str, cat: str = "stage", **args) -> Span:
+    """A new (unstarted) span; entering the context starts it."""
+    return Span(name, cat, args)
+
+
+def begin(name: str, cat: str = "stage", **args) -> Span:
+    """Manual form: a STARTED span the caller must ``.end()``."""
+    return Span(name, cat, args).start()
+
+
+def instant(name: str, cat: str = "event", **args) -> None:
+    """A zero-duration event (supervisor retries, ladder steps, sentinel
+    rollbacks).  Recorded only when tracing is enabled."""
+    if not enabled():
+        return
+    with _LOCK:
+        sid = _NEXT_ID[0]
+        _NEXT_ID[0] += 1
+    stack = _stack()
+    _append({"id": sid, "parent": stack[-1].sid if stack else None,
+             "name": name, "cat": cat, "ts": time.time(), "dur": None,
+             "pid": os.getpid(), "tid": threading.get_ident(),
+             "args": dict(args)})
+
+
+def _append(event: dict) -> None:
+    global _DROPPED
+    with _LOCK:
+        if len(_EVENTS) >= MAX_EVENTS:
+            _DROPPED += 1
+            return
+        _EVENTS.append(event)
+
+
+def events() -> list[dict]:
+    """A snapshot copy of the recorded events (spans + instants)."""
+    with _LOCK:
+        return [dict(e) for e in _EVENTS]
+
+
+def event_count() -> int:
+    with _LOCK:
+        return len(_EVENTS)
+
+
+def events_since(index: int) -> list[dict]:
+    with _LOCK:
+        return [dict(e) for e in _EVENTS[index:]]
+
+
+def dropped_events() -> int:
+    return _DROPPED
+
+
+def reset() -> None:
+    """Clear the buffer and the calling thread's span stack (tests; a
+    long-lived server between requests)."""
+    global _DROPPED
+    with _LOCK:
+        _EVENTS.clear()
+        _DROPPED = 0
+    _stack().clear()
+
+
+def stage_seconds(prefix: str = "") -> dict:
+    """Total recorded span seconds aggregated by span name (optionally
+    name-prefix-filtered) — the summary table scripts/trace_report.py
+    renders."""
+    out: dict[str, float] = {}
+    for e in events():
+        if e["dur"] is None or not e["name"].startswith(prefix):
+            continue
+        out[e["name"]] = out.get(e["name"], 0.0) + e["dur"]
+    return out
+
+
+def chrome_trace() -> dict:
+    """The buffer as a Chrome trace event object (Perfetto-loadable)."""
+    trace_events = []
+    for e in events():
+        ev = {"name": e["name"], "cat": e["cat"],
+              "ts": e["ts"] * 1e6, "pid": e["pid"], "tid": e["tid"],
+              "args": {**e["args"], "id": e["id"],
+                       **({"parent": e["parent"]}
+                          if e["parent"] is not None else {})}}
+        if e["dur"] is None:
+            ev.update(ph="i", s="t")
+        else:
+            ev.update(ph="X", dur=e["dur"] * 1e6)
+        trace_events.append(ev)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": _DROPPED}}
+
+
+def _atomic_text(path: str, text: str) -> None:
+    # local tmp+rename (not utils/io.atomic_write: that module imports the
+    # native-runtime loader, and the tracer must stay stdlib-importable)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def write_chrome_trace(path: str) -> str:
+    _atomic_text(path, json.dumps(chrome_trace()))
+    return path
+
+
+def write_jsonl(path: str) -> str:
+    _atomic_text(path, "".join(json.dumps(e) + "\n" for e in events()))
+    return path
+
+
+def write(path: str) -> str:
+    """Format by extension: ``.jsonl`` -> event log, else Chrome trace."""
+    if path.endswith(".jsonl"):
+        return write_jsonl(path)
+    return write_chrome_trace(path)

@@ -26,12 +26,12 @@ candidate rounds) carry ``dist == +inf``; downstream consumers mask on
 from __future__ import annotations
 
 import math
-import time
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tsne_flink_tpu.obs import trace as obtrace
 from tsne_flink_tpu.ops.metrics import pairwise
 from tsne_flink_tpu.ops.zorder import zorder_permutation
 
@@ -941,12 +941,12 @@ def knn_project_refined(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
         subs: dict = {}
 
         def run(name, f, *a):
-            t0 = time.time()
-            # graftlint: disable=host-sync -- deliberate sync point: the
-            # decomposed dispatch exists to TIME each substage (the
-            # prepare-stage observability contract, round 6)
-            out = jax.block_until_ready(f(*a))
-            subs[name] = subs.get(name, 0.0) + time.time() - t0
+            with obtrace.span(f"knn.{name}", cat="knn") as sp:
+                # graftlint: disable=host-sync -- deliberate sync point:
+                # the decomposed dispatch exists to TIME each substage
+                # (the prepare-stage observability contract, round 6)
+                out = jax.block_until_ready(f(*a))
+            subs[name] = subs.get(name, 0.0) + sp.seconds
             return out
 
         def stage(label, f):
@@ -1026,10 +1026,10 @@ def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
             if aot_key is not None:
                 from tsne_flink_tpu.utils import aot
                 fn = aot.wrap(fn, aot_key, f"knn-{method}")
-            t0 = time.time()
-            # graftlint: disable=host-sync -- deliberate: substage timing
-            out = jax.block_until_ready(fn(x))
-            on_substage({"exact": time.time() - t0})
+            with obtrace.span("knn.exact", cat="knn", method=method) as sp:
+                # graftlint: disable=host-sync -- deliberate: substage timing
+                out = jax.block_until_ready(fn(x))
+            on_substage({"exact": sp.seconds})
             return out
         return exact_fn(x)
     if method == "project":
@@ -1042,13 +1042,13 @@ def knn(x: jnp.ndarray, k: int, method: str, metric: str = "sqeuclidean",
                                        tiles=tiles, on_substage=on_substage,
                                        aot_key=aot_key)
         if on_substage is not None:
-            t0 = time.time()
-            # graftlint: disable=host-sync -- deliberate: substage timing
-            out = jax.block_until_ready(jax.jit(
-                lambda xx, kk: knn_project(xx, k, metric, rounds, kk,
-                                           tiles=tiles))(
-                x, key if key is not None else jax.random.key(0)))
-            on_substage({"zorder_seed": time.time() - t0})
+            with obtrace.span("knn.zorder_seed", cat="knn") as sp:
+                # graftlint: disable=host-sync -- deliberate: substage timing
+                out = jax.block_until_ready(jax.jit(
+                    lambda xx, kk: knn_project(xx, k, metric, rounds, kk,
+                                               tiles=tiles))(
+                    x, key if key is not None else jax.random.key(0)))
+            on_substage({"zorder_seed": sp.seconds})
             return out
         return knn_project(x, k, metric, rounds, key, tiles=tiles)
     raise ValueError(f"Knn method '{method}' not defined")

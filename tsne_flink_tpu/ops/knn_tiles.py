@@ -44,8 +44,9 @@ hit is still bit-identical to the cold run that wrote it.)
 from __future__ import annotations
 
 import math
-import time
 from dataclasses import asdict, dataclass, replace
+
+from tsne_flink_tpu.obs import trace as obtrace
 
 #: usable working-set budget per backend when the caller does not pass
 #: ``hbm_bytes``: TPU v5e-class chips carry 16 GiB HBM of which the
@@ -287,11 +288,12 @@ def autotune_knn_tiles(x, k: int, metric: str = "sqeuclidean", *,
             # graftlint: disable=host-sync -- deliberate: the autotuner IS
             # a measurement loop; each candidate must complete on-device
             out = jax.block_until_ready(f())  # compile + first run
-            t0 = time.time()
-            for _ in range(max(1, reps)):
-                # graftlint: disable=host-sync -- deliberate: timing rep
-                out = jax.block_until_ready(f())
-            timings[c] = (time.time() - t0) / max(1, reps)
+            with obtrace.span("knn.autotune", cat="autotune",
+                              candidate=int(c), reps=int(reps)) as sp:
+                for _ in range(max(1, reps)):
+                    # graftlint: disable=host-sync -- deliberate: timing rep
+                    out = jax.block_until_ready(f())
+            timings[c] = sp.seconds / max(1, reps)
             del out
         return min(timings, key=timings.get), timings
 

@@ -31,7 +31,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+from tsne_flink_tpu.obs import metrics as obmetrics
+from tsne_flink_tpu.obs import trace as obtrace
+from tsne_flink_tpu.models.tsne import (TELEMETRY_FIELDS, TsneConfig,
+                                        TsneState, optimize)
 
 AXIS = "points"
 
@@ -85,10 +88,14 @@ class ShardedOptimizer:
         #: signature).  None (library callers) = in-process jit only.
         self.aot_plan = aot_plan
         self._aot_fns = {}
+        #: in-loop telemetry trace of the last ``__call__(telemetry=True)``
+        #: run: host numpy [n_loss_slots, len(TELEMETRY_FIELDS)] (obs)
+        self.telemetry_ = None
 
     def _segment_fn(self, num_iters: int, with_edges: bool = False,
                     trace_edge_pad: int | None = None,
-                    edges_extra: bool = False, with_health: bool = False):
+                    edges_extra: bool = False, with_health: bool = False,
+                    with_telemetry: bool = False):
         """``with_edges``: host-prebuilt edge arrays ride as extra inputs.
         ``trace_edge_pad``: the edge conversion instead runs IN-TRACE on each
         shard's local rows (static pad per shard) — the only form available
@@ -97,9 +104,12 @@ class ShardedOptimizer:
         the split-blocks layout (jidx/jval are the width-k forward block,
         the edge arrays the reverse-only block; attraction sums both).
         ``with_health``: the segment additionally returns the divergence
-        sentinel's replicated finiteness flag (models/tsne.optimize)."""
+        sentinel's replicated finiteness flag (models/tsne.optimize).
+        ``with_telemetry``: the segment also carries and returns the
+        replicated in-loop telemetry trace (obs; same slot keying as the
+        losses)."""
         key = (num_iters, with_edges, trace_edge_pad, edges_extra,
-               with_health)
+               with_health, with_telemetry)
         if key in self._fns:
             return self._fns[key]
         cfg_ = self.cfg
@@ -110,12 +120,16 @@ class ShardedOptimizer:
             # donation would hand XLA a buffer the host still reads
             fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters,
                                  edges_extra=edges_extra,
-                                 with_health=with_health))
+                                 with_health=with_health,
+                                 with_telemetry=with_telemetry))
         else:
             n_local = self.n_local
 
             def local_run(state, jidx, jval, valid, start_iter, loss_carry,
-                          edges=None):
+                          *rest):
+                rest = list(rest)
+                edges = rest.pop(0) if with_edges else None
+                tel_carry = rest.pop(0) if with_telemetry else None
                 row_offset = lax.axis_index(AXIS) * n_local
                 if edges is None and trace_edge_pad is not None:
                     from tsne_flink_tpu.ops.affinities import assemble_edges
@@ -125,22 +139,30 @@ class ShardedOptimizer:
                                 start_iter=start_iter, num_iters=num_iters,
                                 loss_carry=loss_carry, edges=edges,
                                 edges_extra=edges_extra,
-                                with_health=with_health)
+                                with_health=with_health,
+                                with_telemetry=with_telemetry,
+                                telemetry_carry=tel_carry)
 
             pspec = P(AXIS)
             state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
             in_specs = [state_spec, pspec, pspec, pspec, P(), P()]
             if with_edges:
                 in_specs.append((pspec, pspec, pspec))
-            # loss trace (and the sentinel flag) are psum-replicated
-            out_specs = ((state_spec, P(), P()) if with_health
-                         else (state_spec, P()))
+            if with_telemetry:
+                in_specs.append(P())  # telemetry carry is replicated
+            # loss trace (and the telemetry rows / sentinel flag) are
+            # psum/pmin/pmax-replicated global scalars
+            outs = [state_spec, P()]
+            if with_telemetry:
+                outs.append(P())
+            if with_health:
+                outs.append(P())
             from tsne_flink_tpu.utils.compat import shard_map
             fn = jax.jit(
                 shard_map(
                     local_run, mesh=self.mesh,
                     in_specs=tuple(in_specs),
-                    out_specs=out_specs,
+                    out_specs=tuple(outs),
                 ))
         self._fns[key] = fn
         return fn
@@ -295,20 +317,26 @@ class ShardedOptimizer:
         return fn.lower(*args, edges) if edges is not None else fn.lower(*args)
 
     def _run_segment(self, fn, state, jidx, jval, valid, start, losses,
-                     edges=None):
+                     edges=None, tel=None, telemetry: bool = False):
         if self.n_devices == 1:
-            return fn(state, jidx, jval, start_iter=start, loss_carry=losses,
-                      edges=edges)
+            kw = dict(start_iter=start, loss_carry=losses, edges=edges)
+            if telemetry:
+                kw["telemetry_carry"] = tel
+            return fn(state, jidx, jval, **kw)
+        args = [state, jidx, jval, valid, start, losses]
         if edges is not None:
-            return fn(state, jidx, jval, valid, start, losses, edges)
-        return fn(state, jidx, jval, valid, start, losses)
+            args.append(edges)
+        if telemetry:
+            args.append(tel)
+        return fn(*args)
 
     def __call__(self, state: TsneState, jidx, jval, *, start_iter: int = 0,
                  loss_carry=None, checkpoint_every: int = 0,
                  checkpoint_cb=None, pre_padded_valid=None, unpad: bool = True,
                  edge_pad: int | None = None, extra_edges=None,
                  health_check: bool = False, health_retries: int = 3,
-                 events: list | None = None):
+                 events: list | None = None, telemetry: bool = False,
+                 telemetry_carry=None):
         """Run iterations [start_iter, cfg.iterations); if checkpointing,
         ``checkpoint_cb(state, next_iter, losses)`` fires every
         ``checkpoint_every`` iterations with the UNPADDED state.
@@ -325,6 +353,15 @@ class ShardedOptimizer:
         (``runtime/faults.py``) also live in this loop: ``nan@optimize``
         poisons a segment's input state, ``kill@optimize:segN`` SIGKILLs
         at the boundary after segment N's checkpoint.
+
+        ``telemetry`` arms the in-loop telemetry trace the same way the
+        sentinel is armed (``models/tsne.optimize(with_telemetry=True)``):
+        a replicated ``[n_loss_slots, len(TELEMETRY_FIELDS)]`` array rides
+        the loop carry across segments (``telemetry_carry`` resumes a
+        partial trace) and lands host-side in ``self.telemetry_`` after
+        the run — zero extra in-segment host syncs; off = bit-identical
+        program (pinned by tests/test_obs.py).  Each segment is wrapped
+        in an ``optimize.segment`` obs span.
 
         Multi-controller callers pass arrays that are ALREADY padded global
         jax.Arrays (host-side pad/slice of non-addressable arrays is
@@ -389,6 +426,12 @@ class ShardedOptimizer:
                      else self._shard_reverse_block(extra_edges))
         else:
             edges = self._build_edges(jidx, jval)
+        tel = None
+        if telemetry:
+            tel = (jnp.asarray(telemetry_carry, state.y.dtype)
+                   if telemetry_carry is not None
+                   else jnp.zeros((max(self.cfg.n_loss_slots, 1),
+                                   len(TELEMETRY_FIELDS)), state.y.dtype))
         from tsne_flink_tpu.runtime import faults
         inj = faults.injector()
         total = self.cfg.iterations
@@ -402,12 +445,13 @@ class ShardedOptimizer:
             if step <= 0:
                 break
             seg_key = (step, edges is not None, trace_pad,
-                       extra_edges is not None, health_check)
+                       extra_edges is not None, health_check, telemetry)
             fn = self._maybe_aot(
                 self._segment_fn(step, with_edges=edges is not None,
                                  trace_edge_pad=trace_pad,
                                  edges_extra=extra_edges is not None,
-                                 with_health=health_check), seg_key)
+                                 with_health=health_check,
+                                 with_telemetry=telemetry), seg_key)
             seg_index += 1
             run_state = state
             if inj is not None:
@@ -418,44 +462,59 @@ class ShardedOptimizer:
                     # end to end
                     run_state = run_state._replace(
                         y=run_state.y.at[0, 0].set(jnp.nan))
-            out = self._run_segment(fn, run_state, jidx, jval, valid,
-                                    it, losses, edges)
-            if health_check:
-                new_state, new_losses, ok = out
-                if not bool(ok):  # ONE host scalar read, at the boundary
-                    from tsne_flink_tpu.runtime import health as rhealth
-                    if retries_left <= 0:
-                        raise rhealth.DivergenceError(it, health_retries)
-                    retries_left -= 1
-                    seg_index -= 1  # the retry re-runs the same segment
-                    eta = self.cfg.learning_rate
-                    self.cfg = rhealth.halved_eta(self.cfg)
-                    self._fns.clear()  # cfg changed: segment fns retrace
-                    self._aot_fns.clear()  # (and their AOT wrappers rekey)
-                    state = rhealth.fresh_momentum(state)
-                    ev = rhealth.rollback_event(
-                        segment_start=it, step=step, eta_before=eta,
-                        eta_after=self.cfg.learning_rate,
-                        retries_left=retries_left)
-                    if events is not None:
-                        events.append(ev)
-                    import sys
-                    print(f"# sentinel: non-finite segment at iteration "
-                          f"{it}; rolled back, eta {eta} -> "
-                          f"{self.cfg.learning_rate}, retrying",
-                          file=sys.stderr)
-                    continue
+            with obtrace.span("optimize.segment", cat="optimize",
+                              seg=seg_index, start_iter=int(it),
+                              num_iters=int(step)) as sp:
+                out = self._run_segment(fn, run_state, jidx, jval, valid,
+                                        it, losses, edges, tel,
+                                        telemetry=telemetry)
+                out = out if isinstance(out, tuple) else (out,)
+                new_state, new_losses = out[0], out[1]
+                new_tel = out[2] if telemetry else None
+                if health_check:
+                    ok = out[-1]
+                    if not bool(ok):  # ONE host scalar read, at boundary
+                        from tsne_flink_tpu.runtime import health as rhealth
+                        if retries_left <= 0:
+                            raise rhealth.DivergenceError(it, health_retries)
+                        retries_left -= 1
+                        seg_index -= 1  # the retry re-runs the segment
+                        eta = self.cfg.learning_rate
+                        self.cfg = rhealth.halved_eta(self.cfg)
+                        self._fns.clear()  # cfg changed: fns retrace
+                        self._aot_fns.clear()  # (and AOT wrappers rekey)
+                        state = rhealth.fresh_momentum(state)
+                        ev = rhealth.rollback_event(
+                            segment_start=it, step=step, eta_before=eta,
+                            eta_after=self.cfg.learning_rate,
+                            retries_left=retries_left)
+                        if events is not None:
+                            events.append(ev)
+                        sp.set(rollback=True)
+                        obmetrics.counter("runtime.rollback").inc()
+                        obtrace.instant("sentinel.rollback", cat="runtime",
+                                        **{k: v for k, v in ev.items()
+                                           if k != "type"})
+                        import sys
+                        print(f"# sentinel: non-finite segment at "
+                              f"iteration {it}; rolled back, eta {eta} -> "
+                              f"{self.cfg.learning_rate}, retrying",
+                              file=sys.stderr)
+                        continue
                 state, losses = new_state, new_losses
-            else:
-                state, losses = out
-            it += step
-            if checkpoint_cb is not None and it < total:
-                checkpoint_cb(self._unpad(state) if unpad else state,
-                              it, losses)
+                if telemetry:
+                    tel = new_tel
+                it += step
+                if checkpoint_cb is not None and it < total:
+                    checkpoint_cb(self._unpad(state) if unpad else state,
+                                  it, losses)
             if inj is not None:
                 # kill@optimize:segN — AFTER the boundary's checkpoint, so
                 # the resume contract is what the kill exercises
                 inj.fire("optimize", seg=seg_index, point="boundary")
+        if telemetry:
+            # the one host read of the telemetry trace, after the loop
+            self.telemetry_ = np.asarray(tel)
         return (self._unpad(state) if unpad else state), losses
 
 

@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+from tsne_flink_tpu.obs import trace as obtrace
 from tsne_flink_tpu.ops.affinities import joint_distribution, pairwise_affinities
 from tsne_flink_tpu.parallel.knn import project_knn_sharded, ring_knn
 from tsne_flink_tpu.parallel.mesh import AXIS, make_mesh, pad_rows
@@ -457,35 +458,41 @@ class SpmdPipeline:
         the segmented / checkpointable optimizer path.  With an
         ``artifact_cache`` the outputs are content-addressed on disk and a
         hit skips the sharded program entirely, bit-identical."""
-        fp = self._artifact_fp(x, key)
-        if fp is not None:
-            from tsne_flink_tpu.utils import artifacts as art
-            got = self.artifact_cache.load(
-                art.KIND_SPMD, fp, ("jidx", "jval", "y", "update", "gains"))
-            if got is not None:
-                return (jnp.asarray(got["jidx"]), jnp.asarray(got["jval"]),
-                        TsneState(y=jnp.asarray(got["y"]),
-                                  update=jnp.asarray(got["update"]),
-                                  gains=jnp.asarray(got["gains"])))
-        while True:
-            self._build_prepared()
-            *xp, valid = self._pad(x)
-            jidx, jval, state, dropped, needed, nnz = self._prepared(
-                *xp, valid, self._key_data(key))
-            if not self._maybe_escalate(dropped, needed, nnz):
-                break
-        self._check_dropped(dropped)
-        n = self.n
-        out = (jidx[:n], jval[:n],
-               TsneState(y=state.y[:n], update=state.update[:n],
-                         gains=state.gains[:n]))
-        if fp is not None:
-            from tsne_flink_tpu.utils import artifacts as art
-            self.artifact_cache.save(
-                art.KIND_SPMD, fp,
-                {"jidx": out[0], "jval": out[1], "y": out[2].y,
-                 "update": out[2].update, "gains": out[2].gains})
-        return out
+        with obtrace.span("spmd.prepare", cat="prepare",
+                          devices=int(self.n_devices)) as sp:
+            fp = self._artifact_fp(x, key)
+            if fp is not None:
+                from tsne_flink_tpu.utils import artifacts as art
+                got = self.artifact_cache.load(
+                    art.KIND_SPMD, fp,
+                    ("jidx", "jval", "y", "update", "gains"))
+                if got is not None:
+                    sp.set(cache="warm")
+                    return (jnp.asarray(got["jidx"]),
+                            jnp.asarray(got["jval"]),
+                            TsneState(y=jnp.asarray(got["y"]),
+                                      update=jnp.asarray(got["update"]),
+                                      gains=jnp.asarray(got["gains"])))
+            while True:
+                self._build_prepared()
+                *xp, valid = self._pad(x)
+                jidx, jval, state, dropped, needed, nnz = self._prepared(
+                    *xp, valid, self._key_data(key))
+                if not self._maybe_escalate(dropped, needed, nnz):
+                    break
+            self._check_dropped(dropped)
+            n = self.n
+            out = (jidx[:n], jval[:n],
+                   TsneState(y=state.y[:n], update=state.update[:n],
+                             gains=state.gains[:n]))
+            if fp is not None:
+                from tsne_flink_tpu.utils import artifacts as art
+                self.artifact_cache.save(
+                    art.KIND_SPMD, fp,
+                    {"jidx": out[0], "jval": out[1], "y": out[2].y,
+                     "update": out[2].update, "gains": out[2].gains})
+                sp.set(cache="cold")
+            return out
 
     def host_state(self, state: TsneState) -> TsneState:
         """PADDED (possibly non-addressable) global state -> UNPADDED host
@@ -503,7 +510,8 @@ class SpmdPipeline:
                            loss_carry=None, resume_state: TsneState | None = None,
                            checkpoint_every: int = 0, checkpoint_cb=None,
                            health_check: bool = False,
-                           health_retries: int = 3, events: list | None = None):
+                           health_retries: int = 3, events: list | None = None,
+                           telemetry: bool = False):
         """prepare() + the segmented ShardedOptimizer (same mesh): gives
         --spmd runs the same checkpoint/resume semantics as the host-staged
         pipeline, returning the full ``(TsneState, losses)``.
@@ -541,7 +549,7 @@ class SpmdPipeline:
                                 checkpoint_cb=checkpoint_cb,
                                 health_check=health_check,
                                 health_retries=health_retries,
-                                events=events)
+                                events=events, telemetry=telemetry)
 
         # ---- multi-controller: no host pad/slice of global arrays anywhere
         while True:
@@ -582,7 +590,8 @@ class SpmdPipeline:
                             checkpoint_cb=cb, pre_padded_valid=valid,
                             unpad=False, edge_pad=max(8, (e + 7) // 8 * 8),
                             health_check=health_check,
-                            health_retries=health_retries, events=events)
+                            health_retries=health_retries, events=events,
+                            telemetry=telemetry)
 
     def __call__(self, x, key):
         """Fused fast path: the whole job in one compiled sharded program.
@@ -595,13 +604,15 @@ class SpmdPipeline:
         if (getattr(self.cfg, "attraction", "auto") == "edges"
                 and self._edge_pad is None):
             self._size_edge_pad(x, key)
-        while True:
-            *xp, valid = self._pad(x)
-            y, losses, dropped, needed, nnz = self._fn()(
-                *xp, valid, self._key_data(key), jnp.int32(0),
-                self._loss0(xp[-1].dtype))
-            if not self._maybe_escalate(dropped, needed, nnz):
-                break
+        with obtrace.span("spmd.pipeline", cat="pipeline",
+                          devices=int(self.n_devices)):
+            while True:
+                *xp, valid = self._pad(x)
+                y, losses, dropped, needed, nnz = self._fn()(
+                    *xp, valid, self._key_data(key), jnp.int32(0),
+                    self._loss0(xp[-1].dtype))
+                if not self._maybe_escalate(dropped, needed, nnz):
+                    break
         self._check_dropped(dropped)  # dropped is replicated: every process
         if jax.process_count() > 1:
             return y, losses

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import sys
 
+from tsne_flink_tpu.obs import metrics as obmetrics
+from tsne_flink_tpu.obs import trace as obtrace
 from tsne_flink_tpu.runtime.ladder import OomLadder
 
 #: substrings identifying a device out-of-memory error across the ways
@@ -63,6 +65,8 @@ class Supervisor:
         self.events: list = events if events is not None else []
         # last good optimizer snapshot, updated at checkpoint boundaries
         self._last = None
+        #: host telemetry trace of the last run_optimize(telemetry=True)
+        self.last_telemetry = None
 
     # ---- shared ladder plumbing -------------------------------------------
 
@@ -73,10 +77,17 @@ class Supervisor:
             raise exc
         self.events.append({"type": "oom", "stage": stage,
                             "error": str(exc)[:200]})
+        # obs: recovery decisions are counted and traced like every other
+        # pipeline event (one snapshot schema instead of a private list)
+        obmetrics.counter("runtime.oom").inc()
+        obtrace.instant("supervisor.oom", cat="runtime", stage=stage)
         deg = self.ladder.demote(stage)
         if deg is None:
             raise LadderExhausted(stage, exc) from exc
         self.events.append({"type": "degrade", **deg.as_dict()})
+        obmetrics.counter("runtime.degrade").inc()
+        obtrace.instant("supervisor.degrade", cat="runtime", stage=stage,
+                        action=deg.action)
         print(f"# supervisor: OOM in '{stage}' — {deg.action} "
               f"({deg.before!r} -> {deg.after!r}), relaunching the stage",
               file=sys.stderr)
@@ -134,18 +145,21 @@ class Supervisor:
     def run_optimize(self, make_runner, cfg, state, jidx, jval, *,
                      start_iter: int = 0, loss_carry=None,
                      checkpoint_every: int = 0, checkpoint_cb=None,
-                     extra_edges=None):
+                     extra_edges=None, telemetry: bool = False):
         """Segmented optimize with OOM-ladder relaunch and the sentinel.
 
         ``make_runner(cfg)`` builds a ``ShardedOptimizer``-compatible
         runner for the (possibly demoted) config.  The supervisor shims
         the checkpoint callback to capture the last good snapshot, so a
         repulsion demotion relaunches from the last segment boundary —
-        not from iteration 0."""
+        not from iteration 0.  ``telemetry`` arms the in-loop telemetry
+        trace (obs); the runner's host-side trace lands in
+        ``self.last_telemetry`` after the run."""
         import numpy as np
 
         self._last = {"state": state, "it": start_iter,
                       "losses": loss_carry}
+        self.last_telemetry = None
 
         def cb(st, next_iter, losses):
             self._last = {"state": st, "it": next_iter,
@@ -156,14 +170,16 @@ class Supervisor:
         for attempt in range(self.max_retries + 1):
             runner = make_runner(self.optimize_cfg(cfg))
             try:
-                return runner(self._last["state"], jidx, jval,
-                              start_iter=self._last["it"],
-                              loss_carry=self._last["losses"],
-                              checkpoint_every=checkpoint_every,
-                              checkpoint_cb=cb, extra_edges=extra_edges,
-                              health_check=self.health_check,
-                              health_retries=self.health_retries,
-                              events=self.events)
+                out = runner(self._last["state"], jidx, jval,
+                             start_iter=self._last["it"],
+                             loss_carry=self._last["losses"],
+                             checkpoint_every=checkpoint_every,
+                             checkpoint_cb=cb, extra_edges=extra_edges,
+                             health_check=self.health_check,
+                             health_retries=self.health_retries,
+                             events=self.events, telemetry=telemetry)
+                self.last_telemetry = getattr(runner, "telemetry_", None)
+                return out
             # graftlint: disable=exception-hygiene -- not a swallow:
             # _handle_oom re-raises everything that is not a
             # ladder-eligible device OOM (and logs the step it takes)
@@ -173,6 +189,9 @@ class Supervisor:
                     {"type": "relaunch", "stage": "optimize",
                      "from_iter": int(self._last["it"]),
                      "repulsion": self.optimize_cfg(cfg).repulsion})
+                obtrace.instant("supervisor.relaunch", cat="runtime",
+                                stage="optimize",
+                                from_iter=int(self._last["it"]))
         raise AssertionError("unreachable: _handle_oom raises or demotes")
 
 
@@ -198,7 +217,8 @@ def supervised_embed(x, cfg, *, supervisor: Supervisor,
                      knn_method: str = "bruteforce", knn_iterations=None,
                      knn_refine=None, knn_blocks: int = 8, seed: int = 0,
                      sym_width=None, affinity_assembly=None,
-                     artifact_cache=None, knn_autotune: bool = False):
+                     artifact_cache=None, knn_autotune: bool = False,
+                     telemetry: bool = False):
     """Supervised single-device pipeline: ``models/tsne.tsne_embed`` with
     the supervisor wrapped around prepare and a segmented optimizer run
     (the sentinel needs segment boundaries to roll back to).  Same key
@@ -237,5 +257,6 @@ def supervised_embed(x, cfg, *, supervisor: Supervisor,
     state, losses = supervisor.run_optimize(
         lambda c: ShardedOptimizer(c, n, n_devices=1), cfg, state,
         prep.jidx, prep.jval, extra_edges=prep.extra_edges,
-        checkpoint_every=seg, checkpoint_cb=lambda *a: None)
+        checkpoint_every=seg, checkpoint_cb=lambda *a: None,
+        telemetry=telemetry)
     return state.y, losses

@@ -36,30 +36,30 @@ import hashlib
 import os
 import pickle
 import tempfile
-import time
 
+from tsne_flink_tpu.obs import metrics as obmetrics
+from tsne_flink_tpu.obs import trace as obtrace
 from tsne_flink_tpu.utils.env import env_bool, env_raw
 
 MAGIC = "tsne_flink_tpu-aot-v1"
 
-#: process-global stats: AOT entry hits/misses and lower+compile seconds
-#: spent through :func:`wrap` (the entry-function share of the compile
-#: meter below).
-_STATS = {"hits": 0, "misses": 0, "compile_seconds": 0.0}
-
 _ENABLED_OVERRIDE: bool | None = None
 
 # ---- compile meter ---------------------------------------------------------
+# Absorbed into the obs metrics registry (obs/metrics.py): the meter's
+# counts live under the `compile.*` counters and AOT hit/miss stats under
+# `aot.*`, so one metrics snapshot carries everything.  compile_snapshot()
+# and stats() remain the stable read API.
 
-_METER = {"count": 0, "seconds": 0.0}
 _METER_INSTALLED = False
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
 def install_compile_meter() -> None:
     """Idempotently register a jax monitoring listener accumulating every
-    backend-compile duration — jit, pjit and AOT alike — so entry points
-    can report measured compile seconds per stage."""
+    backend-compile duration — jit, pjit and AOT alike — into the
+    ``compile.count``/``compile.seconds`` metrics, so entry points can
+    report measured compile seconds per stage."""
     global _METER_INSTALLED
     if _METER_INSTALLED:
         return
@@ -67,8 +67,8 @@ def install_compile_meter() -> None:
 
     def _on_duration(event, duration, **_kw):
         if event == _COMPILE_EVENT:
-            _METER["count"] += 1
-            _METER["seconds"] += float(duration)
+            obmetrics.counter("compile.count").inc()
+            obmetrics.counter("compile.seconds").inc(float(duration))
 
     monitoring.register_event_duration_secs_listener(_on_duration)
     _METER_INSTALLED = True
@@ -78,7 +78,8 @@ def compile_snapshot() -> dict:
     """{'count': int, 'seconds': float} compiled so far this process (the
     meter only counts from :func:`install_compile_meter` on); callers diff
     two snapshots around a stage."""
-    return dict(_METER)
+    return {"count": int(obmetrics.counter_value("compile.count")),
+            "seconds": float(obmetrics.counter_value("compile.seconds"))}
 
 
 # ---- enablement / stats ----------------------------------------------------
@@ -103,7 +104,13 @@ def enabled() -> bool:
 
 
 def stats() -> dict:
-    return dict(_STATS)
+    """AOT entry hits/misses and lower+compile seconds spent through
+    :func:`wrap` — read from the ``aot.*`` metrics counters (the registry
+    is the single store; this is the stable record-facing shape)."""
+    return {"hits": int(obmetrics.counter_value("aot.hits")),
+            "misses": int(obmetrics.counter_value("aot.misses")),
+            "compile_seconds":
+                float(obmetrics.counter_value("aot.compile_seconds"))}
 
 
 def cache_label() -> str:
@@ -111,7 +118,8 @@ def cache_label() -> str:
     compiled), warm (every wrapped entry loaded), or mixed."""
     if not enabled():
         return "off"
-    h, m = _STATS["hits"], _STATS["misses"]
+    s = stats()
+    h, m = s["hits"], s["misses"]
     if m and h:
         return "mixed"
     if m:
@@ -254,16 +262,20 @@ class _PersistentFn:
     def __call__(self, *args, **kwargs):
         if self._compiled is None:
             key = entry_key(self._key_parts, args, kwargs, self._label)
-            got = _load(self._root, self._label, key)
+            with obtrace.span("aot.load", cat="aot",
+                              label=self._label) as sp:
+                got = _load(self._root, self._label, key)
+                sp.set(hit=got is not None)
             if got is not None:
                 self._compiled = got
                 self.cache_state = "warm"
-                _STATS["hits"] += 1
+                obmetrics.counter("aot.hits").inc()
             else:
-                t0 = time.time()
-                compiled = self._jitted.lower(*args, **kwargs).compile()
-                _STATS["compile_seconds"] += time.time() - t0
-                _STATS["misses"] += 1
+                with obtrace.span("aot.compile", cat="aot",
+                                  label=self._label) as sp:
+                    compiled = self._jitted.lower(*args, **kwargs).compile()
+                obmetrics.counter("aot.compile_seconds").inc(sp.seconds)
+                obmetrics.counter("aot.misses").inc()
                 self.cache_state = ("cold" if _save(self._root, self._label,
                                                     key, compiled)
                                     else "uncached")

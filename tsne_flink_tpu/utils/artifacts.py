@@ -35,12 +35,13 @@ from __future__ import annotations
 import hashlib
 import os
 import tempfile
-import time
 import zipfile
 from dataclasses import dataclass
 
 import numpy as np
 
+from tsne_flink_tpu.obs import memory as obmem
+from tsne_flink_tpu.obs import trace as obtrace
 from tsne_flink_tpu.utils.env import env_int, env_raw
 
 MAGIC = "tsne_flink_tpu-artifact-v1"
@@ -244,6 +245,9 @@ class PrepareResult:
     affinity_fp: str | None
     knn_substages: dict | None = None  # {substage: seconds} on cold runs
     knn_tiles: dict | None = None      # resolved tile plan (as_record())
+    #: per-stage observed memory watermark (obs/memory.py):
+    #: {stage: {"observed_bytes", "basis"}} sampled at each stage end
+    memory: dict | None = None
 
     @property
     def cache_label(self) -> str:
@@ -367,105 +371,125 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
             assembly=assembly, sym_width=sym_width)
 
     # ---- kNN graph ----
-    t0 = time.time()
-    if inj is not None:
-        inj.fire("knn")
-    knn_subs = tiles_rec = None
-    if knn is not None:
-        idx, dist = knn
-        knn_cache = "input"
-    else:
-        n, d = int(x.shape[0]), int(x.shape[1])
-        knn_method, rounds, refine = resolve_knn_plan(
-            n, d, knn_method, knn_rounds, knn_refine, k=k)
-        got = (cache.load(KIND_KNN, knn_fp, ("idx", "dist"))
-               if cache is not None else None)
-        if got is not None:
-            idx = jnp.asarray(got["idx"])
-            dist = jnp.asarray(got["dist"])
-            knn_cache = "warm"
+    # the span IS the stage timer (obs/trace.py): knn_seconds below is its
+    # duration, and the stage-end memory watermark lands beside it.  The
+    # try/finally keeps the span stack clean when a stage raises (a real
+    # or injected OOM unwinds to the supervisor, which relaunches prepare)
+    sp_knn = obtrace.begin("prepare.knn", cat="prepare")
+    try:
+        if inj is not None:
+            inj.fire("knn")
+        knn_subs = tiles_rec = None
+        if knn is not None:
+            idx, dist = knn
+            knn_cache = "input"
         else:
-            # resolve (and optionally autotune) the tile plan only when the
-            # graph is actually computed — a warm hit must not pay a probe
-            from tsne_flink_tpu.ops.knn_tiles import (autotune_knn_tiles,
-                                                      pick_knn_tiles)
-            tiles = knn_tiles or pick_knn_tiles(n, d, k)
-            if knn_autotune and knn_tiles is None:
-                tiles = autotune_knn_tiles(x, k, metric, plan=tiles,
-                                           key=key)
-            tiles_rec = tiles.as_record()
-            # decomposed per-substage dispatch (ops/knn.knn on_substage):
-            # each stage is its own reused jitted executable — compiles
-            # shrink and the substage breakdown is a free byproduct.  With
-            # the AOT executable cache on, each stage fn is additionally
-            # serialized keyed on this prepare's graftcheck plan twin
-            # (round 7): a warm process loads the compiled executables and
-            # pays zero trace/lower/compile time for the kNN stage.
-            from tsne_flink_tpu.utils import aot
-            aot_key = None
-            if aot.enabled():
-                from tsne_flink_tpu.analysis.audit.plan import PlanConfig
-                plan = PlanConfig(n=n, d=d, k=k,
-                                  backend=jax.default_backend(),
-                                  knn_method=knn_method, knn_rounds=rounds,
-                                  knn_refine=refine, name="prepare")
-                aot_key = {**aot.plan_key_parts(plan), "metric": metric,
-                           "dtype": str(np.asarray(x[:0]).dtype),
-                           "tiles": tiles.as_record()}
-            subs: dict = {}
-            idx, dist = knn_dispatch(
-                x, k, knn_method, metric, blocks=knn_blocks, rounds=rounds,
-                refine=refine, key=key, tiles=tiles, on_substage=subs.update,
-                aot_key=aot_key)
-            idx.block_until_ready()
-            knn_subs = {kk: round(v, 3) for kk, v in subs.items()}
-            knn_cache = "off"
-            if cache is not None:
-                cache.save(KIND_KNN, knn_fp, {"idx": idx, "dist": dist})
-                knn_cache = "cold"
-    t_knn = time.time() - t0
+            n, d = int(x.shape[0]), int(x.shape[1])
+            knn_method, rounds, refine = resolve_knn_plan(
+                n, d, knn_method, knn_rounds, knn_refine, k=k)
+            got = (cache.load(KIND_KNN, knn_fp, ("idx", "dist"))
+                   if cache is not None else None)
+            if got is not None:
+                idx = jnp.asarray(got["idx"])
+                dist = jnp.asarray(got["dist"])
+                knn_cache = "warm"
+            else:
+                # resolve (and optionally autotune) the tile plan only when
+                # the graph is actually computed — a warm hit must not pay
+                # a probe
+                from tsne_flink_tpu.ops.knn_tiles import (autotune_knn_tiles,
+                                                          pick_knn_tiles)
+                tiles = knn_tiles or pick_knn_tiles(n, d, k)
+                if knn_autotune and knn_tiles is None:
+                    tiles = autotune_knn_tiles(x, k, metric, plan=tiles,
+                                               key=key)
+                tiles_rec = tiles.as_record()
+                # decomposed per-substage dispatch (ops/knn.knn
+                # on_substage): each stage is its own reused jitted
+                # executable — compiles shrink and the substage breakdown
+                # is a free byproduct.  With the AOT executable cache on,
+                # each stage fn is additionally serialized keyed on this
+                # prepare's graftcheck plan twin (round 7): a warm process
+                # loads the compiled executables and pays zero
+                # trace/lower/compile time for the kNN stage.
+                from tsne_flink_tpu.utils import aot
+                aot_key = None
+                if aot.enabled():
+                    from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+                    plan = PlanConfig(n=n, d=d, k=k,
+                                      backend=jax.default_backend(),
+                                      knn_method=knn_method,
+                                      knn_rounds=rounds,
+                                      knn_refine=refine, name="prepare")
+                    aot_key = {**aot.plan_key_parts(plan), "metric": metric,
+                               "dtype": str(np.asarray(x[:0]).dtype),
+                               "tiles": tiles.as_record()}
+                subs: dict = {}
+                idx, dist = knn_dispatch(
+                    x, k, knn_method, metric, blocks=knn_blocks,
+                    rounds=rounds, refine=refine, key=key, tiles=tiles,
+                    on_substage=subs.update, aot_key=aot_key)
+                idx.block_until_ready()
+                knn_subs = {kk: round(v, 3) for kk, v in subs.items()}
+                knn_cache = "off"
+                if cache is not None:
+                    cache.save(KIND_KNN, knn_fp, {"idx": idx, "dist": dist})
+                    knn_cache = "cold"
+        sp_knn.set(cache=knn_cache)
+    finally:
+        sp_knn.end()
+    t_knn = sp_knn.seconds
+    mem_knn = obmem.sample("knn")
     if on_stage is not None:
         on_stage("knn", t_knn, knn_cache)
 
     # ---- affinities: beta search + symmetrized assembly ----
-    t1 = time.time()
-    if inj is not None:
-        inj.fire("affinities")
-    got = (cache.load(KIND_AFFINITY, affinity_fp, ("label", "jidx", "jval"))
-           if affinity_fp is not None else None)
-    label = str(got["label"]) if got is not None else None
-    if got is not None and label == "blocks" and not all(
-            nm in got for nm in ("rsrc", "rdst", "rval")):
-        got = None  # torn blocks entry: recompute (save below replaces it)
-    if got is not None:
-        jidx = jnp.asarray(got["jidx"])
-        jval = jnp.asarray(got["jval"])
-        extra = (tuple(jnp.asarray(got[nm])
-                       for nm in ("rsrc", "rdst", "rval"))
-                 if label == "blocks" else None)
-        affinity_cache = "warm"
-    else:
-        from tsne_flink_tpu.ops.affinities import (affinity_auto,
-                                                   affinity_blocks,
-                                                   affinity_pipeline)
-        if assembly == "auto":
-            jidx, jval, extra, label = affinity_auto(idx, dist, perplexity)
-        elif assembly == "blocks":
-            jidx, jval, extra = affinity_blocks(idx, dist, perplexity)
-            label = "blocks"
+    sp_aff = obtrace.begin("prepare.affinities", cat="prepare")
+    try:
+        if inj is not None:
+            inj.fire("affinities")
+        got = (cache.load(KIND_AFFINITY, affinity_fp,
+                          ("label", "jidx", "jval"))
+               if affinity_fp is not None else None)
+        label = str(got["label"]) if got is not None else None
+        if got is not None and label == "blocks" and not all(
+                nm in got for nm in ("rsrc", "rdst", "rval")):
+            got = None  # torn blocks entry: recompute (save replaces it)
+        if got is not None:
+            jidx = jnp.asarray(got["jidx"])
+            jval = jnp.asarray(got["jval"])
+            extra = (tuple(jnp.asarray(got[nm])
+                           for nm in ("rsrc", "rdst", "rval"))
+                     if label == "blocks" else None)
+            affinity_cache = "warm"
         else:
-            jidx, jval = affinity_pipeline(idx, dist, perplexity, sym_width,
-                                           assembly=assembly)
-            extra, label = None, assembly
-        jval.block_until_ready()
-        affinity_cache = "off"
-        if affinity_fp is not None:
-            arrays = {"label": label, "jidx": jidx, "jval": jval}
-            if extra is not None:
-                arrays.update(rsrc=extra[0], rdst=extra[1], rval=extra[2])
-            cache.save(KIND_AFFINITY, affinity_fp, arrays)
-            affinity_cache = "cold"
-    t_aff = time.time() - t1
+            from tsne_flink_tpu.ops.affinities import (affinity_auto,
+                                                       affinity_blocks,
+                                                       affinity_pipeline)
+            if assembly == "auto":
+                jidx, jval, extra, label = affinity_auto(idx, dist,
+                                                         perplexity)
+            elif assembly == "blocks":
+                jidx, jval, extra = affinity_blocks(idx, dist, perplexity)
+                label = "blocks"
+            else:
+                jidx, jval = affinity_pipeline(idx, dist, perplexity,
+                                               sym_width, assembly=assembly)
+                extra, label = None, assembly
+            jval.block_until_ready()
+            affinity_cache = "off"
+            if affinity_fp is not None:
+                arrays = {"label": label, "jidx": jidx, "jval": jval}
+                if extra is not None:
+                    arrays.update(rsrc=extra[0], rdst=extra[1],
+                                  rval=extra[2])
+                cache.save(KIND_AFFINITY, affinity_fp, arrays)
+                affinity_cache = "cold"
+        sp_aff.set(cache=affinity_cache, assembly=label)
+    finally:
+        sp_aff.end()
+    t_aff = sp_aff.seconds
+    mem_aff = obmem.sample("affinities")
     if on_stage is not None:
         on_stage("affinities", t_aff, affinity_cache)
 
@@ -474,4 +498,5 @@ def prepare(x=None, *, knn=None, neighbors: int, knn_method: str,
                          knn_seconds=t_knn, affinity_seconds=t_aff,
                          knn_cache=knn_cache, affinity_cache=affinity_cache,
                          knn_fp=knn_fp, affinity_fp=affinity_fp,
-                         knn_substages=knn_subs, knn_tiles=tiles_rec)
+                         knn_substages=knn_subs, knn_tiles=tiles_rec,
+                         memory={"knn": mem_knn, "affinities": mem_aff})

@@ -15,7 +15,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 from tsne_flink_tpu.utils.env import env_bool, env_str
 
@@ -50,7 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "the Barnes-Hut backend at large N (an explicit "
                         "theta is a request for theta-gated BH semantics); "
                         "theta 0 always means the exact path")
-    p.add_argument("--loss", "--lossFile", dest="loss", default="loss.txt")
+    # default routed under results/ (run outputs must not litter the repo
+    # root; the directory is created by the atomic writer)
+    p.add_argument("--loss", "--lossFile", dest="loss",
+                   default=os.path.join("results", "loss.txt"))
     p.add_argument("--knnIterations", type=int, default=None,
                    help="project-kNN Z-order rounds; default auto "
                         "(reference default 3, Tsne.scala:61). Since round 3 "
@@ -206,6 +208,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "but launches anyway.  The result is embedded in "
                         "v2 checkpoints so a resume can detect a config "
                         "whose predicted footprint drifted")
+    # --- observability (tsne_flink_tpu/obs/) ---
+    p.add_argument("--trace", nargs="?", const="default", default=None,
+                   help="record the obs span trace (prepare stages, kNN "
+                        "substages, optimize segments, AOT load/compile, "
+                        "supervisor recovery) and write it at exit: "
+                        "--trace writes Chrome-trace JSON to "
+                        "results/trace.json (load in Perfetto — "
+                        "ui.perfetto.dev — or chrome://tracing), "
+                        "--trace=PATH picks the file (a .jsonl extension "
+                        "writes the structured JSONL event log instead). "
+                        "Env default: $TSNE_TRACE")
+    p.add_argument("--metricsOut", default=None,
+                   help="write the obs metrics snapshot (compile meter, "
+                        "AOT stats, runtime recovery counters, memory "
+                        "watermarks — obs/metrics.py) as JSON to this "
+                        "path at exit. Env default: $TSNE_METRICS_OUT")
+    p.add_argument("--telemetry", action="store_true",
+                   help="device-side in-loop telemetry: grad-norm, gains "
+                        "mean/max and the embedding bbox ride the "
+                        "optimize loop carry at the KL report interval "
+                        "(zero in-segment host syncs, read once per "
+                        "segment boundary; off = bit-identical program). "
+                        "The last values land in --metricsOut gauges")
     p.add_argument("--profile", default=None,
                    help="jax.profiler trace directory")
     # multi-host bring-up (jax.distributed over DCN — the analog of the
@@ -452,22 +477,56 @@ def _save_final_checkpoint(args, state, iterations, losses,
                                            prior_events))
 
 
+def _write_obs_outputs(trace_path, metrics_path, telemetry=None) -> None:
+    """End-of-run obs export: the Chrome trace (--trace), the metrics
+    snapshot (--metricsOut), and — when in-loop telemetry ran — its last
+    recorded row as ``telemetry.*`` gauges so the snapshot carries it."""
+    from tsne_flink_tpu.obs import metrics as obmetrics
+    from tsne_flink_tpu.obs import trace as obtrace
+    if telemetry is not None and len(telemetry):
+        from tsne_flink_tpu.models.tsne import TELEMETRY_FIELDS
+        for f, v in zip(TELEMETRY_FIELDS, telemetry[-1]):
+            obmetrics.gauge(f"telemetry.{f}").set(float(v))
+    if trace_path:
+        obtrace.write(trace_path)
+        print(f"# obs trace written to {trace_path} (load in Perfetto / "
+              "chrome://tracing)", file=sys.stderr)
+    if metrics_path:
+        obmetrics.write_snapshot(metrics_path)
+        print(f"# obs metrics snapshot written to {metrics_path}",
+              file=sys.stderr)
+
+
 def main(argv=None) -> int:
     """Arg parse + dispatch.  Wraps :func:`_main` so the trace-time
-    mixed-precision setting (--dtype bfloat16) cannot leak into a later
-    in-process caller (tests call main() directly)."""
+    mixed-precision setting (--dtype bfloat16) — and the obs tracer
+    enablement — cannot leak into a later in-process caller (tests call
+    main() directly)."""
+    from tsne_flink_tpu.obs import trace as obtrace
     from tsne_flink_tpu.ops.metrics import matmul_dtype, set_matmul_dtype
     from tsne_flink_tpu.utils import aot
     prev = matmul_dtype()
     prev_aot = aot.enabled_override()
+    prev_trace = obtrace.enabled_override()
+    # the whole-run span is created HERE so the finally can close it on
+    # every exit path (arg errors, --executionPlan early returns,
+    # failures): a leaked open span would corrupt the parent stack of
+    # later in-process runs.  end() is idempotent — _main ends it before
+    # writing the trace file so the span is included.
+    sp_run = obtrace.begin("cli.run", cat="cli")
     try:
-        return _main(argv)
+        return _main(argv, sp_run)
     finally:
+        sp_run.end()
         set_matmul_dtype(prev)
         aot.set_enabled(prev_aot)
+        obtrace.set_enabled(prev_trace)
 
 
-def _main(argv=None) -> int:
+def _main(argv=None, sp_run=None) -> int:
+    from tsne_flink_tpu.obs import trace as _obtrace
+    if sp_run is None:  # direct _main callers (none in-tree) still time
+        sp_run = _obtrace.begin("cli.run", cat="cli")
     parser = build_parser()
     args = parser.parse_args(argv)
 
@@ -480,6 +539,20 @@ def _main(argv=None) -> int:
     from tsne_flink_tpu.utils import aot
     aot.set_enabled(args.aotCache)
     aot.install_compile_meter()
+
+    # obs tracing (tsne_flink_tpu/obs/): --trace[=path] overrides the
+    # $TSNE_TRACE default; the tracer is enabled up front so every stage
+    # span below is recorded, and the file is written at the exits
+    from tsne_flink_tpu.obs import trace as obtrace
+    if args.trace is not None:
+        trace_path = (os.path.join("results", "trace.json")
+                      if args.trace == "default" else args.trace)
+    else:
+        trace_path = obtrace.env_trace_path()
+    if trace_path:
+        obtrace.set_enabled(True)
+    metrics_path = args.metricsOut or env_str("TSNE_METRICS_OUT",
+                                              default=None)
 
     if env_bool("TSNE_FORCE_CPU"):
         # dev/test escape hatch: the container's sitecustomize latches the
@@ -571,7 +644,6 @@ def _main(argv=None) -> int:
                              "reverse block per shard, which is impossible "
                              "on non-addressable multi-controller arrays)")
 
-    t0 = time.time()
     dtype_explicit = args.dtype is not None
     args.dtype = args.dtype or "float32"
     if args.dtype == "bfloat16":
@@ -703,9 +775,11 @@ def _main(argv=None) -> int:
             return 0
         if args.profile:
             jax.profiler.start_trace(args.profile)
-        if args.resume or args.checkpoint or args.healthCheck:
-            # --healthCheck needs the segmented form: the sentinel reads
-            # its flag (and rolls back) at segment boundaries
+        if (args.resume or args.checkpoint or args.healthCheck
+                or args.telemetry):
+            # --healthCheck/--telemetry need the segmented form: the
+            # sentinel flag and the telemetry trace are read at segment
+            # boundaries
             start_iter, loss_carry, resume_state, _ = _load_resume(args,
                                                                    dtype)
             state, losses = pipe.run_checkpointable(
@@ -714,7 +788,8 @@ def _main(argv=None) -> int:
                 checkpoint_every=args.checkpointEvery,
                 checkpoint_cb=_make_checkpoint_cb(args),
                 health_check=args.healthCheck,
-                events=supervisor.events)
+                events=supervisor.events,
+                telemetry=args.telemetry)
             y = state.y
             y.block_until_ready()
             if jax.process_count() > 1:
@@ -743,8 +818,12 @@ def _main(argv=None) -> int:
             losses_np = np.asarray(losses)
         tio.write_embedding(args.output, ids, y_np)
         tio.write_loss(args.loss, losses_np)
+        sp_run.end()
+        _write_obs_outputs(trace_path, metrics_path,
+                           getattr(pipe._runner, "telemetry_", None)
+                           if args.telemetry else None)
         print(f"embedded {n} points -> {args.output} "
-              f"({time.time() - t0:.2f}s total, spmd over "
+              f"({sp_run.seconds:.2f}s total, spmd over "
               f"{pipe.n_devices} device(s), backend={jax.default_backend()})")
         return 0
 
@@ -864,7 +943,7 @@ def _main(argv=None) -> int:
         loss_carry=loss_carry, checkpoint_every=args.checkpointEvery,
         checkpoint_cb=_make_checkpoint_cb(args, save_payload, supervisor,
                                           prior_events),
-        extra_edges=extra_edges)
+        extra_edges=extra_edges, telemetry=args.telemetry)
     state.y.block_until_ready()
     if args.profile:
         jax.profiler.stop_trace()
@@ -873,8 +952,12 @@ def _main(argv=None) -> int:
 
     tio.write_embedding(args.output, ids, np.asarray(state.y[:n]))
     tio.write_loss(args.loss, np.asarray(losses))
+    sp_run.end()
+    _write_obs_outputs(trace_path, metrics_path,
+                       supervisor.last_telemetry if args.telemetry
+                       else None)
     print(f"embedded {n} points -> {args.output} "
-          f"({time.time() - t0:.2f}s total, backend={jax.default_backend()})")
+          f"({sp_run.seconds:.2f}s total, backend={jax.default_backend()})")
     return 0
 
 

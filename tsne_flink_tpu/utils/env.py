@@ -144,6 +144,29 @@ _declare("TSNE_TPU_NATIVE_CACHE", "path", None,
          "Build directory for the ctypes native CSV runtime "
          "(utils/native.py). Default: tsne_flink_tpu/native/build.")
 
+# ---- observability (tsne_flink_tpu/obs/) -----------------------------------
+_declare("TSNE_TRACE", "str", None,
+         "Enable the obs span tracer (obs/trace.py) and set its output "
+         "path: a path writes the Chrome trace there (.jsonl extension "
+         "writes the JSONL event log instead), 1/true uses the default "
+         "(results/trace.json; bench.py uses results/bench_trace.json), "
+         "0/false/unset leaves tracing off. The CLI's --trace[=path] "
+         "overrides per run. Load the output in Perfetto "
+         "(ui.perfetto.dev) or chrome://tracing.")
+_declare("TSNE_METRICS_OUT", "path", None,
+         "Write the obs metrics snapshot (obs/metrics.py: counters, "
+         "gauges, histograms — compile meter, AOT stats, runtime "
+         "recovery counts, memory watermarks) as JSON to this path at "
+         "the end of a CLI/bench run. The CLI's --metricsOut overrides; "
+         "bench.py defaults to results/bench_metrics.json.")
+_declare("TSNE_TELEMETRY", "bool", False,
+         "Bench default for device-side in-loop telemetry (the CLI's "
+         "--telemetry / TSNE(telemetry=)): grad-norm, gains mean/max and "
+         "the embedding bbox ride the optimize fori_loop carry at the "
+         "KL report interval (zero in-segment host syncs, read once per "
+         "segment boundary). Off = the optimize program is bit-identical "
+         "to the untelemetered one (pinned by test).")
+
 # ---- bench window-proofing (bench.py) --------------------------------------
 _declare("TSNE_BENCH_T0", "float", None,
          "First-entry wall-clock of the bench invocation, pinned via "
