@@ -79,8 +79,21 @@ RECORD_BASE_KEYS = (
     "theta", "knn_method", "knn_rounds", "knn_refine", "data", "data_seed",
     "peak_flops", "peak_flops_basis", "assembly", "cache", "matmul_dtype",
     "knn_tiles", "audit", "degradations", "aot_cache", "memory",
-    "host_calib",
+    "host_calib", "fleet",
 )
+
+
+def _fleet_context():
+    """The graftfleet job identity this process runs under, or None for a
+    standalone bench (the scheduler sets TSNE_FLEET_JOB on its children —
+    runtime/fleet.py)."""
+    raw = env_str("TSNE_FLEET_JOB", default=None)
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return {"raw": raw}
 
 
 def make_data(n=60_000, d=784, classes=10, seed=DATA_SEED):
@@ -482,6 +495,12 @@ def main():
         # measured host speed + signature (obs/calibrate.py): the
         # cross-round normalization anchor
         "host_calib": host_calib,
+        # graftfleet context (runtime/fleet.py): None for this standalone
+        # single-job bench; a fleet-scheduled run (scripts/run_fleet.py)
+        # records {name, index, attempt, budget_bytes, predicted_peak}
+        # so a record produced under fleet co-residency can never be
+        # mistaken for a solo number
+        "fleet": _fleet_context(),
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not

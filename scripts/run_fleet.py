@@ -1,0 +1,116 @@
+"""graftfleet driver: run a fleet of concurrent embed jobs under one HBM
+budget and emit per-job + fleet JSON records.
+
+The multi-job analog of bench.py (ROADMAP item 4): synthesizes one blob
+dataset per job (distinct seeds — distinct cache keys unless --sharedData),
+schedules them through runtime/fleet.Fleet with graftcheck-predicted
+admission control, and prints one JSON line per job record followed by the
+fleet record (last line, like bench.py's superseding-record convention).
+
+    python scripts/run_fleet.py --jobs 4 --n 5000 --iterations 100
+    python scripts/run_fleet.py --smoke                 # tier-1 shape
+    python scripts/run_fleet.py --faultPlan kill@job:1  # chaos demo
+
+The fleet chaos plan takes ``job``-site clauses only (kill/delay/oom/nan
+@job:N — runtime/faults.py); per-job process-local faults would go on the
+individual specs.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="run-fleet", description="admission-controlled multi-job "
+        "t-SNE fleet (tsne_flink_tpu/runtime/fleet.py)")
+    p.add_argument("--jobs", type=int, default=3)
+    p.add_argument("--n", type=int, default=2000, help="points per job")
+    p.add_argument("--d", type=int, default=32)
+    p.add_argument("--iterations", type=int, default=100)
+    p.add_argument("--perplexity", type=float, default=10.0)
+    p.add_argument("--knnMethod", default="bruteforce",
+                   choices=["auto", "bruteforce", "partition", "project"])
+    p.add_argument("--budget", type=int, default=None,
+                   help="fleet HBM budget in bytes (default: "
+                        "$TSNE_FLEET_HBM_BUDGET, else the backend device "
+                        "budget, else unlimited)")
+    p.add_argument("--maxConcurrent", type=int, default=None,
+                   help="count cap on running jobs (default: "
+                        "$TSNE_FLEET_MAX_JOBS; 0 = none)")
+    p.add_argument("--retries", type=int, default=1)
+    p.add_argument("--jobTimeout", type=float, default=None)
+    p.add_argument("--stageTimeout", type=float, default=None)
+    p.add_argument("--faultPlan", default=None,
+                   help="fleet chaos plan, job-site clauses only "
+                        "(e.g. 'kill@job:1,delay@job:0')")
+    p.add_argument("--workdir", default=os.path.join("results", "fleet"))
+    p.add_argument("--sharedData", action="store_true",
+                   help="every job embeds the SAME dataset (seed 0): the "
+                        "shared artifact-cache demo — one job computes "
+                        "prepare cold, the rest load it warm")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny tier-1 shape: 3 jobs x 64 points x 6 dims "
+                        "x 20 iters on whatever backend is present")
+    return p
+
+
+def make_inputs(args, workdir):
+    import numpy as np
+    paths = []
+    for i in range(args.jobs):
+        seed = 0 if args.sharedData else i
+        path = os.path.join(workdir, f"in{i}.npy")
+        if not (args.sharedData and i > 0):
+            rng = np.random.default_rng(seed)
+            centers = rng.random((8, args.d)).astype(np.float32)
+            labels = rng.integers(0, 8, args.n)
+            x = (centers[labels]
+                 + 0.1 * rng.standard_normal(
+                     (args.n, args.d)).astype(np.float32))
+            np.save(path, x)
+        paths.append(os.path.join(workdir, "in0.npy") if args.sharedData
+                     else path)
+    return paths
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.smoke:
+        args.jobs, args.n, args.d = 3, 64, 6
+        args.iterations, args.perplexity = 20, 4.0
+    os.makedirs(args.workdir, exist_ok=True)
+
+    from tsne_flink_tpu.runtime.fleet import Fleet, JobSpec
+    inputs = make_inputs(args, args.workdir)
+    row_chunk = min(2048, max(16, args.n // 4))
+    specs = [JobSpec(name=f"job{i}", input=inputs[i],
+                     iterations=args.iterations,
+                     perplexity=args.perplexity,
+                     knn_method=args.knnMethod, row_chunk=row_chunk,
+                     seed=i)
+             for i in range(args.jobs)]
+    fleet = Fleet(specs, os.path.join(args.workdir, "work"),
+                  budget_bytes=args.budget,
+                  max_concurrent=args.maxConcurrent,
+                  retries=args.retries, job_timeout=args.jobTimeout,
+                  stage_timeout=args.stageTimeout,
+                  fault_plan=args.faultPlan,
+                  cache_dir=os.path.join(args.workdir, "cache"))
+    record = fleet.run()
+    for job in record["jobs"]:
+        print(json.dumps(job), flush=True)
+    print(json.dumps(record), flush=True)
+    failed = record["fleet"]["failed"]
+    if failed:
+        print(f"# {failed} job(s) failed", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""resource-hygiene fixture (lives under a runtime/ directory because the
+rule scopes itself to runtime/ and utils/ paths): leaked tempfile and lock
+acquisitions, plus context-manager / try-finally / suppressed twins."""
+
+import os
+import tempfile
+import threading
+from tempfile import mkdtemp
+
+LOCK = threading.Lock()
+
+
+def leaky_tempfile():
+    fd, tmp = tempfile.mkstemp()         # VIOLATION: no finally in scope
+    return fd, tmp
+
+
+def leaky_from_import():
+    return mkdtemp()                     # VIOLATION: imported-name form
+
+
+def leaky_lock():
+    LOCK.acquire()                       # VIOLATION: no release path
+    return 1
+
+
+def leaky_named_tempfile():
+    f = tempfile.NamedTemporaryFile(delete=False)  # VIOLATION
+    return f.name
+
+
+def clean_try_finally():
+    fd, tmp = tempfile.mkstemp()
+    try:
+        return fd
+    finally:
+        os.close(fd)
+        os.unlink(tmp)
+
+
+def clean_context_manager():
+    with LOCK:
+        return 2
+
+
+def clean_auto_delete():
+    with tempfile.NamedTemporaryFile() as f:  # delete=True: self-cleaning
+        return f.name
+
+
+def suppressed_leak():
+    # graftlint: disable=resource-hygiene -- fixture: deliberate leak
+    return tempfile.mkdtemp()

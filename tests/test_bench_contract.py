@@ -22,8 +22,10 @@ import pytest
 REPO = os.path.join(os.path.dirname(__file__), os.pardir)
 REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data",
             "assembly", "cache",  # self-describing records (ADVICE r5 #1)
-            "memory", "host_calib"}  # obsgraft: predicted-vs-observed HBM
+            "memory", "host_calib",  # obsgraft: predicted-vs-observed HBM
                                      # + host-calibration on EVERY record
+            "fleet"}  # graftfleet context: None solo, the scheduler's
+                      # {name, index, attempt, budget, peak} under a fleet
 
 
 def run_bench(n, iters, extra_env=None, timeout=600):
@@ -42,7 +44,7 @@ def run_bench(n, iters, extra_env=None, timeout=600):
                  "TSNE_BENCH_MARGIN_S", "TSNE_BENCH_SEG",
                  "TSNE_ARTIFACT_DIR", "TSNE_AFFINITY_ASSEMBLY",
                  "TSNE_TUNNEL_DOWN", "TSNE_KNN_AUTOTUNE",
-                 "TSNE_TELEMETRY"):
+                 "TSNE_TELEMETRY", "TSNE_FLEET_JOB"):
         env.pop(knob, None)
     env.update(extra_env or {})
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
@@ -96,6 +98,18 @@ def test_final_record_carries_resolved_assembly_and_cache():
     assert final["assembly"] in ("sorted", "split", "split-rows", "blocks")
     assert final["cache"] == "off"  # hermetic default in run_bench
     assert final["matmul_dtype"] == "float32"  # cpu run: no bf16 default
+    assert final["fleet"] is None  # standalone bench: no fleet context
+
+
+def test_fleet_context_rides_records_when_scheduled():
+    """graftfleet contract: a bench child launched by the scheduler
+    (TSNE_FLEET_JOB set, runtime/fleet.py) stamps every record with its
+    fleet identity, so a co-resident number can never pose as solo."""
+    ctx = {"name": "job3", "index": 3, "attempt": 1,
+           "budget_bytes": 1 << 30, "predicted_peak": 123}
+    recs = run_bench(800, 20, {"TSNE_FLEET_JOB": json.dumps(ctx)})
+    for rec in recs:
+        assert rec["fleet"] == ctx
 
 
 def test_final_record_carries_knn_substages_and_tile_plan():

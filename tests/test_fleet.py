@@ -1,0 +1,554 @@
+"""graftfleet (ISSUE 8): admission-controlled multi-job scheduler with
+fleet-level chaos, timeouts, and backoff.
+
+Acceptance contracts, all CPU-only:
+
+* the admission controller provably queues a job set whose summed
+  graftcheck-predicted peak HBM exceeds the configured budget, and admits
+  the queued job after a running one finishes;
+* with ``kill@job:1`` mid-segment in a 3-job fleet, the surviving jobs'
+  embeddings are bit-identical to their solo runs (process isolation),
+  and the killed job completes via retry-with-backoff bit-identically;
+* the chaos matrix (``delay@knn``, ``kill@job:N``, ``oom@optimize:segK``)
+  records degradations and fires each fault exactly once;
+* stage/job wall-clock timeouts terminate with exit code 124 (watchdog),
+  and the fleet retries the timed-out job;
+* concurrent cache writes to one dir are serialized by the lock-file
+  protocol (utils/locks.py) — the two-process stress test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.runtime.admission import (ADMIT, DEGRADE, QUEUE,
+                                              AdmissionController,
+                                              predicted_peak_bytes)
+from tsne_flink_tpu.runtime.fleet import (EXIT_TIMEOUT, Fleet, JobSpec,
+                                          Watchdog, job_plan)
+from tsne_flink_tpu.runtime.supervisor import backoff_seconds
+
+pytestmark = pytest.mark.fast
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+N, D, ITERS = 48, 6, 30
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_faults():
+    faults.activate(None)
+    yield
+    faults.activate(None)
+
+
+# ---- fault grammar: delay kind + job site ----------------------------------
+
+def test_fault_grammar_delay_and_job_site():
+    fs = faults.parse_plan("delay@knn,kill@job:1,oom@optimize:seg2")
+    assert [(f.kind, f.site, f.trigger) for f in fs] == [
+        ("delay", "knn", "1"), ("kill", "job", "1"),
+        ("oom", "optimize", "seg2")]
+
+
+@pytest.mark.parametrize("bad", ["corrupt@job:1", "kill@job:seg1",
+                                 "delay@nowhere"])
+def test_fault_grammar_rejects_malformed_fleet_clauses(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+def test_split_fleet_plan_partitions_by_job_index():
+    by_job = faults.split_fleet_plan("kill@job:1,delay@job:0,oom@job:1")
+    assert {(f.kind) for f in by_job[1]} == {"kill", "oom"}
+    assert [f.kind for f in by_job[0]] == ["delay"]
+    with pytest.raises(ValueError, match="site 'job'"):
+        faults.split_fleet_plan("oom@knn:1")  # process-local ≠ fleet level
+
+
+def test_delay_fires_once_and_is_span_recorded(monkeypatch):
+    from tsne_flink_tpu.obs import trace as obtrace
+    monkeypatch.setenv("TSNE_FAULT_DELAY_S", "0.01")
+    inj = faults.FaultInjector(faults.parse_plan("delay@knn:1"))
+    with obtrace.collecting():
+        before = obtrace.event_count()
+        inj.fire("knn")
+        inj.fire("knn")  # fired once, never again
+        evs = obtrace.events_since(before)
+    assert inj.log == [("delay", "knn", "1")]
+    delays = [e for e in evs if e["name"] == "fault.delay"]
+    assert len(delays) == 1 and delays[0]["args"]["site"] == "knn"
+    assert delays[0]["dur"] >= 0.009
+
+
+# ---- supervisor backoff -----------------------------------------------------
+
+def test_backoff_deterministic_jittered_and_capped():
+    a = [backoff_seconds(i, 0.25, 30.0, token="knn") for i in range(6)]
+    assert a == [backoff_seconds(i, 0.25, 30.0, token="knn")
+                 for i in range(6)]
+    for i, v in enumerate(a):  # exponential envelope with [0.5, 1.0] jitter
+        assert 0.5 * 0.25 * 2 ** i <= v <= 0.25 * 2 ** i
+    assert backoff_seconds(30, 0.25, 30.0, token="x") <= 30.0
+    assert backoff_seconds(3, 0.0) == 0.0  # base 0 disables
+    assert (backoff_seconds(2, 1.0, 30.0, token="a")
+            != backoff_seconds(2, 1.0, 30.0, token="b"))
+
+
+def test_supervisor_backoff_rides_events_and_spans(tmp_path, monkeypatch):
+    import jax
+
+    from tsne_flink_tpu.obs import trace as obtrace
+    from tsne_flink_tpu.runtime.supervisor import (Supervisor,
+                                                   run_plan_from_fit)
+    from tsne_flink_tpu.utils.artifacts import ArtifactCache
+    from tsne_flink_tpu.utils.artifacts import prepare as prepare_stage
+    monkeypatch.setenv("TSNE_RETRY_BACKOFF", "0.01")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D))
+    faults.activate("oom@knn:1")
+    from tsne_flink_tpu.models.tsne import TsneConfig
+    cfg = TsneConfig(iterations=ITERS, perplexity=5.0, repulsion="exact",
+                     row_chunk=16)
+    sup = Supervisor(run_plan_from_fit(N, D, 8, cfg, "auto", "bruteforce"))
+    with obtrace.collecting():
+        before = obtrace.event_count()
+        sup.run_prepare(
+            lambda on_stage, assembly="auto", knn_tiles=None: prepare_stage(
+                x, neighbors=8, knn_method="bruteforce",
+                key=jax.random.key(0), perplexity=5.0, assembly=assembly,
+                cache=ArtifactCache(str(tmp_path)), knn_tiles=knn_tiles,
+                on_stage=on_stage))
+        evs = obtrace.events_since(before)
+    assert [e["type"] for e in sup.events] == ["oom", "degrade", "backoff"]
+    bk = sup.events[-1]
+    assert bk["attempt"] == 0 and 0.005 <= bk["seconds"] <= 0.01
+    spans = [e for e in evs if e["name"] == "supervisor.backoff"]
+    assert len(spans) == 1 and spans[0]["dur"] >= 0.004
+
+
+# ---- admission controller ---------------------------------------------------
+
+def small_plan(**kw):
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    return PlanConfig(n=N, d=D, k=8, backend="cpu",
+                      knn_method="bruteforce", repulsion="exact",
+                      name=kw.pop("name", "t"), **kw)
+
+
+def test_admission_admits_within_budget_queues_over_it():
+    plan = small_plan()
+    peak = predicted_peak_bytes(plan)
+    ctl = AdmissionController(int(2.5 * peak), degrade=False)
+    assert ctl.decide(plan, 0).action == ADMIT
+    assert ctl.decide(plan, peak).action == ADMIT
+    d = ctl.decide(plan, 2 * peak)
+    assert d.action == QUEUE and d.predicted_peak == peak
+    assert AdmissionController(None).decide(plan, 10 ** 15).action == ADMIT
+
+
+def test_admission_degrades_to_blocks_when_that_fits():
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    big = PlanConfig(n=100_000, d=784, k=90, backend="tpu",
+                     sym_width=3608, assembly="sorted", name="big")
+    peak_sorted = predicted_peak_bytes(big)
+    ctl = AdmissionController(peak_sorted - 1, degrade=True)
+    d = ctl.decide(big, 0)
+    assert d.action == DEGRADE and d.overrides == {"assembly": "blocks"}
+    assert d.predicted_peak < peak_sorted
+    # degrade off: the same pressure queues instead
+    assert AdmissionController(peak_sorted - 1,
+                               degrade=False).decide(big, 0).action == QUEUE
+
+
+# ---- watchdog ---------------------------------------------------------------
+
+def test_watchdog_fires_on_stage_silence_and_beats_reset():
+    import time
+    fired = []
+    wd = Watchdog(stage_timeout=0.15, label="t",
+                  on_timeout=fired.append, poll_s=0.01).start()
+    try:
+        for _ in range(4):  # 0.4 s of regular beats: no firing
+            time.sleep(0.1)
+            wd.beat("knn")
+        assert fired == []
+        time.sleep(0.4)  # silence: the stage timer expires
+        assert fired == ["stage"]
+    finally:
+        wd.stop()
+
+
+def test_watchdog_job_timeout_beats_do_not_help():
+    import time
+    fired = []
+    wd = Watchdog(job_timeout=0.2, label="t",
+                  on_timeout=fired.append, poll_s=0.01).start()
+    try:
+        for _ in range(5):
+            time.sleep(0.06)
+            wd.beat("x")  # beats reset the STAGE clock, not the job clock
+        assert fired == ["job"]
+    finally:
+        wd.stop()
+
+
+def test_watchdog_unarmed_never_threads():
+    wd = Watchdog().start()
+    assert not wd.armed and wd._thread is None
+
+
+# ---- cross-process cache write locks (satellite) ---------------------------
+
+_LOCK_WORKER = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, sys.argv[3])
+from tsne_flink_tpu.utils.artifacts import MAGIC, ArtifactCache
+root, wid = sys.argv[1], int(sys.argv[2])
+fp = "deadbeef" * 4
+rng = np.random.default_rng(0)  # both workers write IDENTICAL content
+arrays = {"idx": rng.integers(0, 100, (64, 8)),
+          "dist": rng.random((64, 8))}
+cache = ArtifactCache(root)
+for i in range(40):
+    assert cache.save("knn", fp, arrays) in (True, False)
+    got = cache.load("knn", fp, ("idx", "dist"))
+    if got is not None:  # a load either misses cleanly or is intact
+        np.testing.assert_array_equal(got["idx"], arrays["idx"])
+        np.testing.assert_array_equal(got["dist"], arrays["dist"])
+print("ok", wid)
+"""
+
+
+def test_two_process_cache_write_stress_no_torn_entries(tmp_path):
+    """Satellite: two processes hammer one cache dir; every load is
+    intact, and no lock/tmp litter survives."""
+    root = str(tmp_path / "cache")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _LOCK_WORKER, root, str(wid), REPO],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for wid in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o.decode()[-2000:]
+    left = [f for f in os.listdir(root)
+            if f.endswith(".lock") or f.endswith(".tmp")]
+    assert left == [], left
+    from tsne_flink_tpu.utils.artifacts import ArtifactCache
+    got = ArtifactCache(root).load("knn", "deadbeef" * 4, ("idx", "dist"))
+    assert got is not None  # the surviving entry is intact
+
+
+def test_file_lock_mutual_exclusion_and_stale_break(tmp_path):
+    import time
+
+    from tsne_flink_tpu.utils.locks import FileLock
+    path = str(tmp_path / "k.lock")
+    a, b = FileLock(path), FileLock(path)
+    assert a.acquire(0.2) and not b.acquire(0.1)
+    a.release()
+    assert b.acquire(0.2)
+    b.release()
+    # a dead holder's lock is broken after the stale timeout
+    dead = FileLock(path, stale_s=0.05)
+    assert dead.acquire(0.1)
+    dead._held = False  # simulate SIGKILL: no release ever runs
+    time.sleep(0.08)
+    late = FileLock(path, stale_s=0.05)
+    assert late.acquire(1.0)
+    late.release()
+
+
+def test_aot_save_is_lock_guarded(tmp_path, monkeypatch):
+    """The AOT store shares the same FileLock protocol: a held lock makes
+    the (best-effort) save skip instead of interleaving."""
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.utils import aot, locks
+    from tsne_flink_tpu.utils.locks import FileLock
+    compiled = jax.jit(lambda v: v + 1).lower(jnp.zeros(4)).compile()
+    root = str(tmp_path)
+    held = FileLock(aot._path(root, "lbl", "k1") + ".lock")
+    assert held.acquire(0.2)
+    monkeypatch.setattr(locks, "DEFAULT_TIMEOUT_S", 0.1)  # fast skip
+    try:
+        assert aot._save(root, "lbl", "k1", compiled) is False
+    finally:
+        held.release()
+    assert aot._save(root, "lbl", "k1", compiled) is True
+    assert aot._load(root, "lbl", "k1") is not None
+
+
+# ---- fleet integration: admission + chaos matrix ---------------------------
+
+CHILD_ENV = {"TSNE_FORCE_CPU": "1", "TSNE_RETRY_BACKOFF": "0.05",
+             "TSNE_FAULT_DELAY_S": "0.3"}
+
+
+def _specs(data_dir):
+    rng = np.random.default_rng(7)
+    specs = []
+    for i in range(3):
+        centers = rng.normal(size=(3, D)) * 4.0
+        x = (centers[rng.integers(0, 3, N)]
+             + rng.normal(size=(N, D))).astype(np.float32)
+        path = os.path.join(data_dir, f"in{i}.npy")
+        np.save(path, x)
+        specs.append(JobSpec(name=f"job{i}", input=path, iterations=ITERS,
+                             perplexity=5.0, neighbors=8,
+                             repulsion="exact", row_chunk=16, seed=i,
+                             job_timeout=240.0))
+    return specs
+
+
+@pytest.fixture(scope="module")
+def fleet_runs(tmp_path_factory):
+    """ONE clean fleet (admission-constrained) + ONE chaos fleet + solo
+    reference runs, shared by the assertions below (each child process
+    pays a JAX import; running the matrix once keeps tier-1 honest AND
+    affordable)."""
+    base = tmp_path_factory.mktemp("fleet")
+    data = str(base / "data")
+    os.makedirs(data)
+    cache = str(base / "cache")  # shared artifact cache, both fleets
+
+    # --- clean fleet under a 2.5x-peak budget: 3 equal jobs -> 2 run, 1
+    # queued until a slot frees
+    specs = _specs(data)
+    peak = predicted_peak_bytes(job_plan(specs[0], "cpu"))
+    clean = Fleet(specs, str(base / "clean"), budget_bytes=int(2.5 * peak),
+                  backend="cpu", degrade=False, retries=1,
+                  backoff_base=0.05, cache_dir=cache, env=CHILD_ENV)
+    clean_rec = clean.run()
+
+    # --- chaos matrix fleet: delay@knn on job0 (its own plan),
+    # kill@job:1 at fleet level, oom@optimize:seg1 on job2
+    specs2 = _specs(data)
+    specs2[0].fault_plan = "delay@knn:1"
+    specs2[2].fault_plan = "oom@optimize:seg1"
+    chaos = Fleet(specs2, str(base / "chaos"), budget_bytes=None,
+                  backend="cpu", retries=1, backoff_base=0.05,
+                  fault_plan="kill@job:1", cache_dir=cache, env=CHILD_ENV)
+    chaos_rec = chaos.run()
+
+    # --- solo reference runs (one process, alone): job0 clean, job2 with
+    # its oom plan (ladder determinism extends bit-identity to the
+    # degraded job)
+    solo = {}
+    for tag, spec, plan in (("job0", _specs(data)[0], None),
+                            ("job2", _specs(data)[2],
+                             "oom@optimize:seg1")):
+        s = JobSpec.from_dict({**spec.as_dict(), "fault_plan": plan,
+                               "cache_dir": cache,
+                               "out": str(base / f"solo-{tag}.y.npy"),
+                               "record": str(base / f"solo-{tag}.json")})
+        sp_path = str(base / f"solo-{tag}.spec.json")
+        s.save(sp_path)
+        env = dict(os.environ, **CHILD_ENV)
+        env.pop("TSNE_FAULT_PLAN", None)
+        env.pop("TSNE_FLEET_JOB", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "tsne_flink_tpu.runtime.fleet",
+             "--job", sp_path],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        solo[tag] = np.load(s.out)
+    return {"base": base, "clean": clean_rec, "chaos": chaos_rec,
+            "solo": solo}
+
+
+def _job(rec, name):
+    return next(j for j in rec["jobs"] if j["name"] == name)
+
+
+def test_admission_rejects_over_budget_then_admits_after_release(
+        fleet_runs):
+    """Acceptance: summed predicted peak 3P > budget 2.5P -> the third
+    job queues (recorded rejection), runs after a release, and ALL
+    complete; concurrency never exceeded the budget's implied width."""
+    rec = fleet_runs["clean"]
+    f = rec["fleet"]
+    assert f["completed"] == 3 and f["failed"] == 0
+    assert f["max_running"] == 2        # 2P <= 2.5P < 3P
+    assert f["queue_depth_max"] >= 1
+    assert f["admission_rejections"] >= 1
+    for j in rec["jobs"]:
+        assert j["status"] == "done"
+        assert j["decision"]["action"] == "admit"
+        assert j["record"]["status"] == "ok"
+        assert j["record"]["fleet"]["budget_bytes"] == f["budget_bytes"]
+
+
+def test_fleet_job_matches_true_solo_run(fleet_runs):
+    """The fleet adds scheduling, not arithmetic: a job run under fleet
+    co-residency is bit-identical to the same spec run alone."""
+    y_fleet = np.load(_job(fleet_runs["clean"], "job0")["out"])
+    np.testing.assert_array_equal(y_fleet, fleet_runs["solo"]["job0"])
+
+
+def test_chaos_kill_survivors_bit_identical_and_retry_recovers(fleet_runs):
+    """Acceptance: kill@job:1 SIGKILLs job 1 mid-segment; jobs 0 and 2
+    are untouched (bit-identical to their unchaosed/solo outputs), and
+    job 1 itself completes on the clean retry with the identical
+    embedding."""
+    clean, chaos = fleet_runs["clean"], fleet_runs["chaos"]
+    f = chaos["fleet"]
+    assert f["completed"] == 3 and f["failed"] == 0
+    j1 = _job(chaos, "job1")
+    assert j1["attempts"] == 2 and j1["failure"] == "killed"
+    assert f["retries"] >= 1
+    for name in ("job0", "job1"):  # survivors + the recovered victim
+        np.testing.assert_array_equal(
+            np.load(_job(chaos, name)["out"]),
+            np.load(_job(clean, name)["out"]))
+    # job0 (delay only) also matches its TRUE solo run
+    np.testing.assert_array_equal(np.load(_job(chaos, "job0")["out"]),
+                                  fleet_runs["solo"]["job0"])
+
+
+def test_chaos_matrix_faults_fire_exactly_once(fleet_runs):
+    chaos = fleet_runs["chaos"]
+    # delay@knn on job0: fired once, recorded in the per-job record
+    rec0 = _job(chaos, "job0")["record"]
+    assert rec0["faults_fired"] == [["delay", "knn", "1"]]
+    # kill@job:1: one fleet chaos injection, and the retry ran clean
+    assert [c["clause"] for c in chaos["chaos"]] == ["kill@job:1"]
+    assert chaos["chaos"][0] == {"clause": "kill@job:1", "job": "job1",
+                                 "attempt": 1,
+                                 "injected": "kill@optimize:seg1"}
+    rec1 = _job(chaos, "job1")["record"]  # attempt 2's record
+    assert rec1["faults_fired"] == [] and rec1["fleet"]["attempt"] == 2
+    # oom@optimize:seg1 on job2: fired once, ladder demotion recorded
+    rec2 = _job(chaos, "job2")["record"]
+    assert rec2["faults_fired"] == [["oom", "optimize", "seg1"]]
+    assert [d["action"] for d in rec2["degradations"]] == [
+        "repulsion-demote"]
+    assert [e["type"] for e in rec2["events"]] == [
+        "oom", "degrade", "backoff", "relaunch"]
+
+
+def test_chaos_degraded_job_is_deterministic_vs_solo(fleet_runs):
+    """Ladder determinism extends to the fleet: job2's oom-degraded
+    embedding equals the SAME spec+fault run solo."""
+    np.testing.assert_array_equal(
+        np.load(_job(fleet_runs["chaos"], "job2")["out"]),
+        fleet_runs["solo"]["job2"])
+
+
+def test_stage_timeout_kills_then_retry_completes(tmp_path):
+    """delay@job:0 slows the first attempt's kNN stage past the stage
+    timeout; the in-job watchdog exits 124, the fleet counts the
+    preemption and the clean retry completes."""
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    spec = _specs(data)[0]
+    spec.job_timeout = None
+    fleet = Fleet([spec], str(tmp_path / "work"), budget_bytes=None,
+                  backend="cpu", retries=1, backoff_base=0.05,
+                  stage_timeout=8.0, fault_plan="delay@job:0",
+                  env={**CHILD_ENV, "TSNE_FAULT_DELAY_S": "60"})
+    rec = fleet.run()
+    job = _job(rec, "job0")
+    assert job["status"] == "done" and job["attempts"] == 2
+    assert job["failure"] == "timeout"
+    assert rec["fleet"]["preemptions"] >= 1
+    assert rec["fleet"]["completed"] == 1
+    y = np.load(job["out"])
+    assert np.isfinite(y).all()
+
+
+# ---- CLI timeout twins ------------------------------------------------------
+
+def _write_csv(tmp, n=N, d=D):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d))
+    inp = os.path.join(tmp, "in.csv")
+    with open(inp, "w") as f:
+        for i in range(n):
+            for j in range(d):
+                f.write(f"{i},{j},{float(x[i, j])!r}\n")
+    return inp
+
+
+def test_cli_stage_timeout_exits_124(tmp_path):
+    """--stageTimeout (env twin TSNE_STAGE_TIMEOUT) with a chaos-delayed
+    kNN stage: the watchdog terminates the run with exit code 124."""
+    tmp = str(tmp_path)
+    inp = _write_csv(tmp)
+    env = dict(os.environ, TSNE_FORCE_CPU="1", TSNE_ARTIFACTS="0",
+               TSNE_FAULT_DELAY_S="60")
+    env.pop("TSNE_FAULT_PLAN", None)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from tsne_flink_tpu.utils.cli import main; "
+         "sys.exit(main(sys.argv[1:]))",
+         "--input", inp, "--output", os.path.join(tmp, "out.csv"),
+         "--dimension", str(D), "--knnMethod", "bruteforce",
+         "--perplexity", "5", "--iterations", "20", "--noCache",
+         "--loss", os.path.join(tmp, "l.txt"),
+         "--faultPlan", "delay@knn:1", "--stageTimeout", "8"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode == EXIT_TIMEOUT, (r.returncode, r.stderr[-500:])
+    assert "watchdog: stage timeout" in r.stdout + r.stderr
+    assert not os.path.exists(os.path.join(tmp, "out.csv"))
+
+
+def test_cli_timeouts_off_by_default_and_watchdog_stops(tmp_path):
+    """In-process runs with generous limits complete normally, and
+    main() stops the watchdog thread (a stale one would os._exit this
+    very test process later)."""
+    import threading
+
+    from tsne_flink_tpu.utils import cli
+    tmp = str(tmp_path)
+    inp = _write_csv(tmp)
+    rc = cli.main(["--input", inp, "--output", os.path.join(tmp, "o.csv"),
+                   "--dimension", str(D), "--knnMethod", "bruteforce",
+                   "--perplexity", "5", "--iterations", "20", "--noCache",
+                   "--loss", os.path.join(tmp, "l.txt"),
+                   "--jobTimeout", "600", "--stageTimeout", "600"])
+    assert rc == 0 and os.path.exists(os.path.join(tmp, "o.csv"))
+    assert cli._WATCHDOG is None
+    assert not any(t.name.startswith("watchdog-")
+                   for t in threading.enumerate())
+
+
+# ---- the driver script ------------------------------------------------------
+
+@pytest.mark.slow
+def test_run_fleet_script_smoke(tmp_path):
+    """scripts/run_fleet.py --smoke: per-job JSON lines then the fleet
+    record last (bench.py's last-line convention), everything completed."""
+    env = dict(os.environ, TSNE_FORCE_CPU="1")
+    env.pop("TSNE_FAULT_PLAN", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_fleet.py"),
+         "--smoke", "--workdir", str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    fleet_rec = lines[-1]
+    assert fleet_rec["fleet"]["completed"] == 3
+    assert len(lines) == 4  # 3 job lines + the fleet record
+    for job in lines[:-1]:
+        assert job["status"] == "done"
+        assert os.path.exists(job["out"])
+
+
+# ---- bench-record contract: the fleet key ----------------------------------
+
+def test_bench_base_keys_carry_fleet():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_fleet_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "fleet" in mod.RECORD_BASE_KEYS

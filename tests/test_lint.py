@@ -60,7 +60,8 @@ def test_all_rules_registered():
     assert set(RULES) == {"env-registry", "jit-hygiene", "host-sync",
                           "dtype-drift", "bench-record-contract",
                           "cli-api-parity", "audit-contract",
-                          "exception-hygiene", "timing-hygiene"}
+                          "exception-hygiene", "timing-hygiene",
+                          "resource-hygiene"}
 
 
 # ---- every fixture violation is found, suppressions silence ---------------
@@ -76,6 +77,7 @@ FIXTURE_FOR_RULE = {
     "exception-hygiene": os.path.join("ops", "fx_exception_hygiene.py"),
     "timing-hygiene": os.path.join("tsne_flink_tpu",
                                    "fx_timing_hygiene.py"),
+    "resource-hygiene": os.path.join("runtime", "fx_resource_hygiene.py"),
 }
 
 

@@ -146,7 +146,9 @@ def test_oom_at_knn_completes_via_ladder(tmp_path):
                                     "oom@knn:1")
     assert np.isfinite(y).all() and np.isfinite(losses).all()
     assert [d["action"] for d in sup.degradations] == ["shrink-knn-tiles"]
-    assert [e["type"] for e in sup.events] == ["oom", "degrade"]
+    # round 8: every ladder relaunch is preceded by a recorded
+    # exponential-backoff sleep (supervisor._backoff)
+    assert [e["type"] for e in sup.events] == ["oom", "degrade", "backoff"]
 
 
 def test_ladder_determinism_same_plan_same_sequence(tmp_path):
@@ -455,7 +457,8 @@ def test_cli_fault_oom_ladder_and_events_in_checkpoint(tmp_path):
     from tsne_flink_tpu.utils import checkpoint as ckpt
     payload = ckpt.load_prepare(ck)
     events = json.loads(payload["events"])
-    assert [e["type"] for e in events["events"]] == ["oom", "degrade"]
+    assert [e["type"] for e in events["events"]] == ["oom", "degrade",
+                                                     "backoff"]
     assert [d["action"] for d in events["degradations"]] == [
         "shrink-knn-tiles"]
 
@@ -467,7 +470,7 @@ def _run_bench(tmp, extra_env):
                TSNE_ARTIFACTS="1", TSNE_ARTIFACT_DIR=os.path.join(tmp, "art"))
     for knob in ("TSNE_BENCH_T0", "TSNE_BENCH_DEADLINE_S", "TSNE_BENCH_SEG",
                  "TSNE_AFFINITY_ASSEMBLY", "TSNE_TUNNEL_DOWN",
-                 "TSNE_FAULT_PLAN"):
+                 "TSNE_FAULT_PLAN", "TSNE_FLEET_JOB"):
         env.pop(knob, None)
     env.update(extra_env)
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
@@ -489,7 +492,8 @@ def test_bench_oom_at_knn_completes_with_recorded_demotion(tmp_path):
                        "TSNE_ARTIFACT_DIR": str(tmp_path / "art1")})
     assert rec1["degradations"], "no ladder step in the bench record"
     assert rec1["degradations"][0]["action"] == "shrink-knn-tiles"
-    assert [e["type"] for e in rec1["runtime_events"]] == ["oom", "degrade"]
+    assert [e["type"] for e in rec1["runtime_events"]] == ["oom", "degrade",
+                                                           "backoff"]
     assert "partial" not in rec1 and rec1["final_kl"] is not None
     rec2 = _run_bench(str(tmp_path),
                       {"TSNE_FAULT_PLAN": "oom@knn:1",

@@ -599,6 +599,12 @@ CLI_ONLY_FLAGS = {
     # TSNE.trace_ / TSNE.metrics_ instead of writing files unasked
     # (--telemetry DOES have the kwarg twin TSNE(telemetry=))
     "trace", "metricsOut",
+    # graftfleet wall-clock limits (runtime/fleet.Watchdog): process-level
+    # controls that terminate with exit code 124 — meaningful for a CLI /
+    # fleet-job process, fatal nonsense for an in-process estimator call
+    # (the watchdog os._exit()s the caller); env twins TSNE_JOB_TIMEOUT /
+    # TSNE_STAGE_TIMEOUT
+    "jobTimeout", "stageTimeout",
 }
 
 #: estimator-only kwargs with no CLI counterpart (none at present; the
@@ -867,6 +873,106 @@ def audit_contract(project: Project):
                     "contract: add a contract(...) entry to "
                     "tsne_flink_tpu/analysis/audit/contracts.py so the "
                     "dtype-contract auditor covers it"))
+    return findings
+
+
+# ---- rule: resource-hygiene ------------------------------------------------
+
+#: tempfile functions that hand the caller a resource to clean up
+_TEMPFILE_ACQS = ("mkstemp", "mkdtemp")
+
+
+def _resource_acquisitions(nodes, tempfile_names: set[str],
+                           from_tmp_names: set[str], fcntl_names: set[str]):
+    """(node, what) for each resource-acquiring call among ``nodes``."""
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if (func.attr in _TEMPFILE_ACQS
+                    and _is_name_in(func.value, tempfile_names)):
+                yield node, f"tempfile.{func.attr}()"
+            elif (func.attr == "NamedTemporaryFile"
+                  and _is_name_in(func.value, tempfile_names)
+                  and any(kw.arg == "delete"
+                          and _literal(kw.value) is False
+                          for kw in node.keywords)):
+                yield node, "tempfile.NamedTemporaryFile(delete=False)"
+            elif func.attr == "acquire":
+                yield node, ".acquire()"
+            elif (func.attr in ("flock", "lockf")
+                  and _is_name_in(func.value, fcntl_names)):
+                yield node, f"fcntl.{func.attr}()"
+        elif isinstance(func, ast.Name) and func.id in from_tmp_names:
+            yield node, f"{func.id}()"
+
+
+@rule("resource-hygiene",
+      "locks/semaphores/tempfiles acquired in runtime/ and utils/ are "
+      "released via a context manager or try/finally")
+def resource_hygiene(project: Project):
+    """A lock or temp resource acquired on a path a fault can interrupt
+    (the fleet SIGKILLs jobs; the watchdog os._exit()s on timeout) must
+    have a structured release: either the acquisition is a ``with``
+    context expression, or the enclosing function carries a
+    ``try/finally`` that owns the cleanup.  The check is lexical by
+    design — a function that acquires and has NO finally anywhere cannot
+    be releasing on its error paths."""
+    findings = []
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        in_scope = any(f"/{d}/" in norm or norm.startswith(f"{d}/")
+                       for d in ("runtime", "utils"))
+        if not in_scope:
+            continue
+        tempfile_names = _import_aliases(mod.tree, "tempfile")
+        fcntl_names = _import_aliases(mod.tree, "fcntl")
+        from_tmp_names = set()
+        for acq in _TEMPFILE_ACQS:
+            from_tmp_names |= _from_import_aliases(mod.tree, acq)
+        with_exprs = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        with_exprs.add(id(sub))
+
+        def check(scope_walker, owner_has_finally, where):
+            for node, what in scope_walker:
+                if id(node) in with_exprs:
+                    continue
+                if owner_has_finally:
+                    continue
+                findings.append(mod.finding(
+                    "resource-hygiene", node,
+                    f"{what} in {where} without a try/finally release "
+                    "path: a fault (SIGKILL chaos, watchdog exit, "
+                    "exception) would leak the lock/tempfile — release "
+                    "via a context manager or try/finally, or suppress "
+                    "with the rationale"))
+
+        for fn, qual in _functions_with_parents(mod.tree):
+            # the nested-def exclusion of _walk_own_body matters: a
+            # nested function is its own scope with its own finally
+            # requirement (it may be called long after the outer returns)
+            has_finally = any(isinstance(sub, ast.Try) and sub.finalbody
+                              for sub in _walk_own_body(fn))
+            check(_resource_acquisitions(_walk_own_body(fn),
+                                         tempfile_names, from_tmp_names,
+                                         fcntl_names),
+                  has_finally, f"'{qual}'")
+        # module-level code (outside any def)
+        mod_level = [n for n in mod.tree.body
+                     if not isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef))]
+        has_finally = any(isinstance(sub, ast.Try) and sub.finalbody
+                          for n in mod_level for sub in ast.walk(n))
+        for n in mod_level:
+            check(_resource_acquisitions(ast.walk(n), tempfile_names,
+                                         from_tmp_names, fcntl_names),
+                  has_finally, "module scope")
     return findings
 
 

@@ -181,6 +181,15 @@ class Span:
         return False
 
 
+def walltime() -> float:
+    """Epoch seconds — the same clock span ``ts`` fields carry.  The ONE
+    blessed raw-clock read for package code whose need is *deadline or
+    stale-file arithmetic* (watchdog timeouts, lock-file age), not timing:
+    durations must still flow through spans (``sp.seconds``), which is
+    what the timing-hygiene lint rule enforces everywhere outside obs/."""
+    return time.time()
+
+
 def span(name: str, cat: str = "stage", **args) -> Span:
     """A new (unstarted) span; entering the context starts it."""
     return Span(name, cat, args)

@@ -1,0 +1,107 @@
+"""Admission control — graftcheck's static HBM model as a scheduler gate.
+
+PR 4 built a per-stage peak-HBM estimator (``analysis/audit/hbm.py``)
+that the CLI uses to REFUSE a single predicted-OOM launch; graftfleet
+turns the same model into a multi-job admission controller: a job is
+admitted only while
+
+    sum(predicted peak of every running job) + its own predicted peak
+        <= the fleet HBM budget,
+
+where each job's predicted peak is ``plan_hbm_report(plan)`` over its
+graftcheck :class:`~tsne_flink_tpu.analysis.audit.plan.PlanConfig` — the
+max over its prepare/optimize stage peaks, i.e. the most the job will
+ever hold, which makes the sum a safe (conservative) co-residency bound:
+jobs at different stages never exceed it.
+
+A job that does not fit may be **degraded at admission** instead of
+queued: the controller re-evaluates the plan under the OOM ladder's
+assembly demotion (``assembly=blocks`` — the memory-flat layout, the
+same rung 2 the runtime ladder takes AFTER an OOM) and admits with the
+override when the degraded plan fits.  Static-degrade-before-launch
+beats dynamic-ladder-after-OOM: the job never pays the failed attempt.
+
+The budget: ``TSNE_FLEET_HBM_BUDGET`` (bytes), else the backend's device
+budget (``HBM_BUDGET_BYTES``) when one exists, else unlimited — on a CPU
+fleet the controller only gates when the operator configures a budget,
+exactly like the single-run audit gate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+#: admission outcomes (``Decision.action``).
+ADMIT = "admit"
+DEGRADE = "admit-degraded"
+QUEUE = "queue"
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One admission verdict for one job plan."""
+
+    action: str                 # admit | admit-degraded | queue
+    predicted_peak: int         # bytes the admitted plan is charged for
+    overrides: dict             # prepare/config overrides ({} unless degraded)
+    reason: str
+
+    def as_dict(self) -> dict:
+        return {"action": self.action,
+                "predicted_peak": int(self.predicted_peak),
+                "overrides": dict(self.overrides), "reason": self.reason}
+
+
+def predicted_peak_bytes(plan) -> int:
+    """The graftcheck HBM model's plan-level peak (max over stage
+    peaks) — the number one running job is charged against the budget."""
+    from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
+    return int(plan_hbm_report(plan)["peak_hbm_est"])
+
+
+def default_budget(backend: str) -> int | None:
+    """``TSNE_FLEET_HBM_BUDGET`` else the backend's device budget else
+    None (unlimited)."""
+    from tsne_flink_tpu.analysis.audit.plan import HBM_BUDGET_BYTES
+    from tsne_flink_tpu.utils.env import env_int
+    env = env_int("TSNE_FLEET_HBM_BUDGET")
+    if env is not None:
+        return int(env)
+    return HBM_BUDGET_BYTES.get(backend)
+
+
+class AdmissionController:
+    """Stateless policy: callers (the fleet) track ``in_use_bytes``."""
+
+    def __init__(self, budget_bytes: int | None, *, degrade: bool = True):
+        self.budget_bytes = (None if budget_bytes is None
+                             else int(budget_bytes))
+        self.degrade = bool(degrade)
+
+    def fits(self, peak: int, in_use_bytes: int) -> bool:
+        if self.budget_bytes is None:
+            return True
+        return in_use_bytes + peak <= self.budget_bytes
+
+    def decide(self, plan, in_use_bytes: int) -> Decision:
+        """Admit, degrade-and-admit, or queue ``plan`` given the bytes
+        already charged to running jobs."""
+        peak = predicted_peak_bytes(plan)
+        if self.fits(peak, in_use_bytes):
+            return Decision(ADMIT, peak, {},
+                            f"predicted peak {peak} fits in-use "
+                            f"{in_use_bytes} within budget")
+        if self.degrade and plan.resolved_assembly() != "blocks":
+            # the ladder's rung-2 demotion, applied statically: blocks
+            # never materializes the hub-widened [N, S] rows
+            demoted = replace(plan, assembly="blocks")
+            peak_b = predicted_peak_bytes(demoted)
+            if peak_b < peak and self.fits(peak_b, in_use_bytes):
+                return Decision(
+                    DEGRADE, peak_b, {"assembly": "blocks"},
+                    f"peak {peak} over budget; blocks assembly predicts "
+                    f"{peak_b}, which fits")
+        return Decision(QUEUE, peak, {},
+                        f"predicted peak {peak} + in-use {in_use_bytes} "
+                        f"exceeds budget {self.budget_bytes}; queued until "
+                        "a running job releases")

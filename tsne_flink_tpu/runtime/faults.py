@@ -13,6 +13,10 @@ kill   ``kill@optimize:seg2``  SIGKILL the process at the chosen optimize
 corrupt ``corrupt@checkpoint`` bit-flip the just-written file
 nan    ``nan@optimize:seg1``   poison the segment's input state with NaN
                                (the caller applies it — see :meth:`fire`)
+delay  ``delay@knn``           sleep ``TSNE_FAULT_DELAY_S`` seconds at the
+                               site entry (latency chaos: slow a stage
+                               without changing a bit of its output; the
+                               sleep is a ``fault.delay`` obs span)
 ====== ======================= ==========================================
 
 Triggers: a bare integer is the Nth call of that site (1-based, default
@@ -22,11 +26,21 @@ same plan + same run = same faults, which is what the ladder-determinism
 test pins.
 
 Instrumented sites: ``knn`` and ``affinities`` (stage entries in
-``utils/artifacts.prepare``), ``optimize`` (segment start for oom/nan,
-segment boundary for kill — ``parallel/mesh.ShardedOptimizer``), and
-``checkpoint`` (after the atomic write in ``utils/checkpoint.save``).
-Each hook is one ``injector()`` read — None when no plan is active, so
-production runs pay a single module-attribute check.
+``utils/artifacts.prepare``), ``optimize`` (segment start for
+oom/nan/delay, segment boundary for kill —
+``parallel/mesh.ShardedOptimizer``), and ``checkpoint`` (after the atomic
+write in ``utils/checkpoint.save``).  Each hook is one ``injector()``
+read — None when no plan is active, so production runs pay a single
+module-attribute check.
+
+**Fleet site** (graftfleet, ``runtime/fleet.py``): ``job`` is scheduler-
+level — the trigger is the JOB INDEX, and the fleet translates the clause
+into the targeted job's own in-process plan for its FIRST attempt only
+(``kill@job:1`` SIGKILLs job 1 at its first optimize segment boundary,
+``delay@job:1`` slows its kNN stage, ``oom@job:1`` injects a synthetic
+OOM there), so a chaos'd job's retry runs clean.  :func:`split_fleet_plan`
+separates the two levels; job-site clauses never reach a process-local
+injector.
 """
 
 from __future__ import annotations
@@ -35,15 +49,20 @@ import os
 import signal
 from dataclasses import dataclass, field
 
-KINDS = ("oom", "kill", "corrupt", "nan")
-SITES = ("knn", "affinities", "optimize", "checkpoint")
+KINDS = ("oom", "kill", "corrupt", "nan", "delay")
+SITES = ("knn", "affinities", "optimize", "checkpoint", "job")
 
-#: where in a segment each optimize-site kind fires: oom/nan at segment
-#: start (so the recovery path sees the failure before any work is
-#: committed), kill at the boundary (after the checkpoint is written —
+#: where in a segment each optimize-site kind fires: oom/nan/delay at
+#: segment start (so the recovery path sees the failure before any work
+#: is committed), kill at the boundary (after the checkpoint is written —
 #: the resume contract is what the kill exercises).
 POINT_FOR_KIND = {"oom": "start", "nan": "start", "kill": "boundary",
-                  "corrupt": "boundary"}
+                  "corrupt": "boundary", "delay": "start"}
+
+#: what a fleet-level ``<kind>@job:N`` clause becomes inside job N's own
+#: process (runtime/fleet.py injects it into the first attempt's plan).
+FLEET_KIND_PLAN = {"kill": "kill@optimize:seg1", "delay": "delay@knn:1",
+                   "oom": "oom@knn:1", "nan": "nan@optimize:seg1"}
 
 
 class InjectedOom(RuntimeError):
@@ -101,8 +120,45 @@ def parse_plan(spec: str) -> list[Fault]:
                 or (trigger.startswith("seg") and trigger[3:].isdigit())):
             raise ValueError(f"fault trigger '{trigger}' is not an "
                              "occurrence count or segN")
+        if site == "job" and (kind not in FLEET_KIND_PLAN
+                              or not trigger.isdigit()):
+            raise ValueError(
+                f"fleet clause '{clause}': site 'job' takes kinds "
+                f"{' | '.join(sorted(FLEET_KIND_PLAN))} and a job-index "
+                "trigger (e.g. kill@job:1)")
         faults.append(Fault(kind, site, trigger))
     return faults
+
+
+def split_fleet_plan(spec: str | None) -> dict[int, list[Fault]]:
+    """Parse a fleet chaos plan into ``{job_index: [Fault, ...]}``.
+    Job-site clauses are the scheduler's to apply
+    (:data:`FLEET_KIND_PLAN`); any non-job clause in a FLEET plan is an
+    error — per-job process-local faults belong on the job spec's own
+    ``fault_plan``, not the fleet's (one level, one owner)."""
+    by_job: dict[int, list[Fault]] = {}
+    for f in parse_plan(spec or ""):
+        if f.site != "job":
+            raise ValueError(
+                f"fleet fault plan only takes site 'job' clauses "
+                f"(got '{f.kind}@{f.site}:{f.trigger}'); put process-local "
+                "faults on the job's own fault_plan")
+        by_job.setdefault(int(f.trigger), []).append(f)
+    return by_job
+
+
+def _sleep_delay(site: str) -> None:
+    """The ``delay@site`` payload: sleep ``TSNE_FAULT_DELAY_S`` seconds,
+    wrapped in an obs span so the injected latency is attributable in the
+    trace (and the timing-hygiene contract stays clean — the wait is a
+    recorded region, not a hidden stall)."""
+    import time
+
+    from tsne_flink_tpu.obs import trace as obtrace
+    from tsne_flink_tpu.utils.env import env_float
+    secs = float(env_float("TSNE_FAULT_DELAY_S"))
+    with obtrace.span("fault.delay", cat="fault", site=site, seconds=secs):
+        time.sleep(secs)
 
 
 def _flip_bit(path: str) -> None:
@@ -155,6 +211,8 @@ class FaultInjector:
                 os.kill(os.getpid(), signal.SIGKILL)
             if f.kind == "corrupt" and path is not None:
                 _flip_bit(path)
+            if f.kind == "delay":
+                _sleep_delay(site)
             if f.kind == "nan":
                 result = f
         return result

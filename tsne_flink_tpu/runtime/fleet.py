@@ -1,0 +1,663 @@
+"""graftfleet — admission-controlled multi-job scheduler.
+
+The reference runs ONE Flink batch job per embedding (``Tsne.scala:33``);
+a production jax_graft deployment runs many concurrent embed jobs under
+one HBM budget (ROADMAP item 4).  This module is that scheduler:
+
+* **admission control** (``runtime/admission.py``): a job launches only
+  while the sum of graftcheck-predicted per-job peak HBM fits the fleet
+  budget; a job that does not fit is statically degraded (blocks
+  assembly) when that makes it fit, else queued FIFO until a running job
+  releases its reservation;
+* **isolation**: every job is its own OS process (``python -m
+  tsne_flink_tpu.runtime.fleet --job spec.json``) with its own output /
+  record files — a job's crash, injected fault, divergence or SIGKILL
+  cannot touch another job's results (the chaos tests pin survivor
+  bit-identity against solo runs);
+* **retries with exponential backoff**: a failed/killed/timed-out job is
+  relaunched up to ``retries`` times after
+  ``supervisor.backoff_seconds`` (deterministic jitter keyed on the job
+  name);
+* **wall-clock timeouts**: the in-job :class:`Watchdog` enforces
+  ``TSNE_JOB_TIMEOUT``/``TSNE_STAGE_TIMEOUT`` (CLI twins
+  ``--jobTimeout``/``--stageTimeout``) by terminating the process with
+  exit code :data:`EXIT_TIMEOUT`; the fleet backstop-kills a job that
+  outlives its deadline anyway (hung before the watchdog armed);
+* **fleet chaos** (``runtime/faults.py`` ``job`` site): ``kill@job:1``
+  SIGKILLs job 1 mid-run (first optimize segment boundary),
+  ``delay@job:1`` slows its kNN stage, ``oom@job:1`` injects a synthetic
+  device OOM — applied to the job's FIRST attempt only, so the retry
+  runs clean;
+* **shared caches**: jobs share one content-addressed artifact cache and
+  one AOT executable cache; writes are serialized per cache key by
+  ``utils/locks.FileLock``;
+* **observability**: the fleet runs under a ``fleet.run`` span with
+  launch/exit/admit/reject/retry instants, counts
+  ``fleet.admission_rejections`` / ``fleet.preemptions`` /
+  ``fleet.retries`` and the live ``fleet.queue_depth`` gauge
+  (``obs/metrics.py``); every job writes a per-job record (its own
+  events, degradations, fired faults and metrics snapshot) and
+  :meth:`Fleet.run` returns the fleet record embedding them all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+from tsne_flink_tpu.obs import metrics as obmetrics
+from tsne_flink_tpu.obs import trace as obtrace
+from tsne_flink_tpu.obs.trace import walltime
+from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.runtime.admission import (AdmissionController, QUEUE,
+                                              default_budget)
+from tsne_flink_tpu.runtime.supervisor import backoff_seconds
+
+#: exit code of a watchdog-terminated (job/stage timeout) process — the
+#: ``timeout(1)`` convention, distinguishable from crashes and SIGKILL.
+EXIT_TIMEOUT = 124
+
+#: job lifecycle states (per-job record ``status``).
+PENDING, RUNNING, DONE, FAILED = "pending", "running", "done", "failed"
+
+
+class Watchdog:
+    """In-process wall-clock limits: terminate when the JOB exceeds
+    ``job_timeout`` seconds total, or when no heartbeat (:meth:`beat` —
+    prepare stage completions, optimize segment boundaries) arrives
+    within ``stage_timeout`` seconds.
+
+    Termination is ``os._exit(EXIT_TIMEOUT)`` by default — the honest
+    semantic of a wall-clock kill (every output writer in the pipeline is
+    atomic, so a mid-write exit never leaves torn files); tests inject
+    ``on_timeout`` to observe instead of dying.  A watchdog with neither
+    limit set never starts a thread."""
+
+    def __init__(self, job_timeout: float | None = None,
+                 stage_timeout: float | None = None, label: str = "job",
+                 on_timeout=None, poll_s: float = 0.05):
+        self.job_timeout = float(job_timeout) if job_timeout else None
+        self.stage_timeout = float(stage_timeout) if stage_timeout else None
+        self.label = label
+        self.on_timeout = on_timeout
+        self.poll_s = float(poll_s)
+        self._stop = threading.Event()
+        self._thread = None
+        self._t0 = None
+        self._last_beat = None
+        self._stage = "start"
+
+    @property
+    def armed(self) -> bool:
+        return self.job_timeout is not None or self.stage_timeout is not None
+
+    def beat(self, stage: str = "") -> None:
+        """Progress heartbeat: resets the stage-timeout clock."""
+        self._last_beat = walltime()
+        if stage:
+            self._stage = stage
+
+    def _fire(self, kind: str, limit: float) -> None:
+        msg = (f"# watchdog: {kind} timeout — {self.label} exceeded "
+               f"{limit:.1f}s (last stage: {self._stage}); terminating "
+               f"with exit code {EXIT_TIMEOUT}")
+        print(msg, file=sys.stderr, flush=True)
+        obtrace.instant("watchdog.timeout", cat="runtime", kind=kind,
+                        limit=limit, stage=self._stage)
+        obmetrics.counter("runtime.watchdog_timeout").inc()
+        if self.on_timeout is not None:
+            self.on_timeout(kind)
+            return
+        os._exit(EXIT_TIMEOUT)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            now = walltime()
+            if (self.job_timeout is not None
+                    and now - self._t0 > self.job_timeout):
+                self._fire("job", self.job_timeout)
+                return
+            if (self.stage_timeout is not None
+                    and now - self._last_beat > self.stage_timeout):
+                self._fire("stage", self.stage_timeout)
+                return
+
+    def start(self) -> "Watchdog":
+        if not self.armed or self._thread is not None:
+            return self
+        self._t0 = self._last_beat = walltime()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"watchdog-{self.label}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+@dataclass
+class JobSpec:
+    """One embed job, JSON-serializable (the fleet<->child contract)."""
+
+    name: str
+    input: str                     # [n, d] points, .npy
+    out: str = ""                  # embedding .npy (fleet fills)
+    record: str = ""               # per-job record JSON (fleet fills)
+    iterations: int = 100
+    perplexity: float = 10.0
+    neighbors: int | None = None   # default 3 * perplexity
+    knn_method: str = "bruteforce"
+    repulsion: str = "auto"
+    assembly: str | None = None    # None = env default (admission may pin)
+    row_chunk: int = 2048
+    seed: int = 0
+    x64: bool = False
+    max_retries: int = 2           # in-job supervisor ladder relaunches
+    fault_plan: str | None = None  # process-local chaos (job's own sites)
+    job_timeout: float | None = None
+    stage_timeout: float | None = None
+    cache_dir: str | None = None   # shared artifact cache root
+
+    def k(self) -> int:
+        return (int(self.neighbors) if self.neighbors is not None
+                else 3 * int(self.perplexity))
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobSpec":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.as_dict(), f, indent=2)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "JobSpec":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+
+def _input_shape(path: str) -> tuple[int, int]:
+    """(n, d) from the .npy header without loading the data."""
+    import numpy as np
+    a = np.load(path, mmap_mode="r")
+    return int(a.shape[0]), int(a.shape[1])
+
+
+def job_plan(spec: JobSpec, backend: str):
+    """The job's graftcheck PlanConfig — the admission controller's input
+    (the same static twin the in-job supervisor hands its ladder)."""
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    n, d = _input_shape(spec.input)
+    return PlanConfig(
+        n=n, d=d, k=spec.k(), backend=backend,
+        iterations=int(spec.iterations), knn_method=spec.knn_method,
+        repulsion=spec.repulsion, assembly=spec.assembly or "auto",
+        row_chunk=int(spec.row_chunk), name=f"fleet-{spec.name}")
+
+
+# ---- the child: one job, one process ---------------------------------------
+
+def run_job(spec: JobSpec) -> dict:
+    """Run one embed job in THIS process and return its record (the
+    subprocess entry point below also writes it to ``spec.record``).
+
+    The pipeline is ``supervisor.supervised_embed`` — the same supervised
+    prepare + segmented-optimize form the CLI and estimator route
+    through, so ladder/sentinel recovery and fault sites behave
+    identically in and out of a fleet."""
+    import jax
+
+    from tsne_flink_tpu.utils.env import env_bool
+
+    if env_bool("TSNE_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    if spec.x64:
+        jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from tsne_flink_tpu.models.tsne import TsneConfig
+    from tsne_flink_tpu.runtime.supervisor import (Supervisor,
+                                                   run_plan_from_fit,
+                                                   supervised_embed)
+    from tsne_flink_tpu.utils import io as tio
+    from tsne_flink_tpu.utils.artifacts import ArtifactCache
+
+    from tsne_flink_tpu.utils.env import env_str
+
+    faults.activate(spec.fault_plan)
+    wd = Watchdog(spec.job_timeout, spec.stage_timeout,
+                  label=spec.name).start()
+    sp = obtrace.begin("fleet.job", cat="fleet", job=spec.name)
+    fleet_ctx = None
+    raw_ctx = env_str("TSNE_FLEET_JOB", default=None)
+    if raw_ctx:
+        try:
+            fleet_ctx = json.loads(raw_ctx)
+        except ValueError:
+            fleet_ctx = {"raw": raw_ctx}
+    record = {"name": spec.name, "status": "ok", "n": None,
+              "iterations": int(spec.iterations), "fleet": fleet_ctx}
+    try:
+        x = np.load(spec.input)
+        record["n"] = int(x.shape[0])
+        import jax.numpy as jnp
+        jnp_x = jnp.asarray(x)
+        from tsne_flink_tpu.utils.cli import pick_repulsion
+        # the CLI's own auto policy (exact below the backend crossover,
+        # else bh/fft) — a fleet job and a solo CLI run of the same spec
+        # must dispatch the same repulsion backend
+        cfg = TsneConfig(iterations=int(spec.iterations),
+                         perplexity=float(spec.perplexity),
+                         row_chunk=int(spec.row_chunk))
+        from dataclasses import replace as _dc_replace
+        cfg = _dc_replace(cfg, repulsion=pick_repulsion(
+            spec.repulsion, cfg.theta, int(x.shape[0]), cfg.n_components,
+            theta_explicit=False))
+        plan = run_plan_from_fit(x.shape[0], x.shape[1], spec.k(), cfg,
+                                 spec.assembly or "auto", spec.knn_method,
+                                 name=f"fleet-{spec.name}")
+        sup = Supervisor(plan, max_retries=int(spec.max_retries))
+        stages: dict = {}
+
+        def on_stage(stage, secs, cache_state):
+            stages[stage] = {"seconds": round(float(secs), 3),
+                             "cache": cache_state}
+            wd.beat(stage)
+
+        y, losses = supervised_embed(
+            jnp_x, cfg, supervisor=sup, neighbors=spec.k(),
+            knn_method=spec.knn_method, seed=int(spec.seed),
+            affinity_assembly=spec.assembly,
+            artifact_cache=(ArtifactCache(spec.cache_dir)
+                            if spec.cache_dir else None),
+            on_stage=on_stage,
+            checkpoint_cb=lambda st, it, ls: wd.beat("optimize"))
+        y = np.asarray(y)
+        if not np.isfinite(y).all():
+            raise RuntimeError(f"job '{spec.name}' produced a non-finite "
+                               "embedding")
+        if spec.out:
+            def write(tmp):
+                with open(tmp, "wb") as f:
+                    np.save(f, y)
+            tio.atomic_write(spec.out, write)
+        inj = faults.injector()
+        record.update(
+            stages=stages,
+            degradations=sup.degradations,
+            events=sup.events,
+            faults_fired=[list(t) for t in (inj.log if inj else [])],
+            final_loss=float(np.asarray(losses)[-1]),
+            backend=jax.default_backend())
+    except BaseException as e:
+        record.update(status="error", error=f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        sp.end()
+        record["seconds"] = round(sp.seconds, 3)
+        record["metrics"] = obmetrics.snapshot()
+        wd.stop()
+        faults.activate(None)
+        if spec.record:
+            try:
+                def write(tmp):
+                    with open(tmp, "w") as f:
+                        json.dump(record, f, indent=2)
+                from tsne_flink_tpu.utils.io import atomic_write
+                atomic_write(spec.record, write)
+            except OSError:
+                pass  # record is evidence, not a correctness dependency
+    return record
+
+
+def main(argv=None) -> int:
+    """Subprocess entry: ``python -m tsne_flink_tpu.runtime.fleet --job
+    spec.json`` — the isolation boundary every fleet job runs behind."""
+    import argparse
+    p = argparse.ArgumentParser(prog="tsne-fleet-job")
+    p.add_argument("--job", required=True, help="JobSpec JSON path")
+    args = p.parse_args(argv)
+    run_job(JobSpec.load(args.job))
+    return 0
+
+
+# ---- the scheduler ---------------------------------------------------------
+
+@dataclass
+class _JobState:
+    """Scheduler-side bookkeeping for one job."""
+
+    spec: JobSpec
+    index: int
+    plan: object
+    chaos: list = field(default_factory=list)   # fleet faults for attempt 1
+    attempts: int = 0
+    status: str = PENDING
+    not_before: float = 0.0      # fleet-clock seconds (backoff gate)
+    decision: dict | None = None
+    peak: int = 0
+    proc: object = None
+    launched_at: float = 0.0
+    seconds: float = 0.0
+    returncode: int | None = None
+    failure: str | None = None   # error | killed | timeout
+    counted_reject: bool = False
+    log_path: str = ""
+
+    def record_path(self) -> str:
+        return self.spec.record
+
+
+class Fleet:
+    """Run ``jobs`` concurrently under one HBM budget.
+
+    ``budget_bytes``: admission budget (None = backend default via
+    ``TSNE_FLEET_HBM_BUDGET`` / the device budget / unlimited).
+    ``retries``: relaunches per job after a crash/kill/timeout (chaos
+    faults are injected into attempt 1 only, so a chaos'd job's retry is
+    clean).  ``fault_plan``: fleet-level chaos, ``job``-site clauses only
+    (``kill@job:1,delay@job:0`` — ``runtime/faults.split_fleet_plan``).
+    ``env``: extra environment for every child (tests pin
+    ``TSNE_FORCE_CPU`` etc.); the fleet's own ``TSNE_FAULT_PLAN`` is
+    always stripped from children — fleet chaos is the fleet's to apply.
+    """
+
+    def __init__(self, jobs, workdir: str, *, budget_bytes=None,
+                 backend: str | None = None, degrade: bool = True,
+                 max_concurrent: int | None = None, retries: int = 1,
+                 job_timeout: float | None = None,
+                 stage_timeout: float | None = None,
+                 backoff_base: float | None = None,
+                 backoff_cap: float | None = None,
+                 fault_plan: str | None = None,
+                 cache_dir: str | None = None, env: dict | None = None,
+                 poll_s: float = 0.05):
+        from tsne_flink_tpu.utils.env import env_float, env_int
+        if backend is None:
+            import jax
+            backend = jax.default_backend()
+        self.backend = backend
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.budget_bytes = (default_budget(backend) if budget_bytes is None
+                             else int(budget_bytes))
+        self.controller = AdmissionController(self.budget_bytes,
+                                              degrade=degrade)
+        self.max_concurrent = (int(env_int("TSNE_FLEET_MAX_JOBS"))
+                               if max_concurrent is None
+                               else int(max_concurrent))
+        self.retries = int(retries)
+        self.job_timeout = (env_float("TSNE_JOB_TIMEOUT")
+                            if job_timeout is None else job_timeout)
+        self.stage_timeout = (env_float("TSNE_STAGE_TIMEOUT")
+                              if stage_timeout is None else stage_timeout)
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.cache_dir = cache_dir
+        self.env = dict(env or {})
+        self.poll_s = float(poll_s)
+        by_job = faults.split_fleet_plan(fault_plan)
+        self.jobs: list[_JobState] = []
+        names = set()
+        for i, spec in enumerate(jobs):
+            if spec.name in names:
+                raise ValueError(f"duplicate job name '{spec.name}' — "
+                                 "names key outputs and records")
+            names.add(spec.name)
+            spec.out = spec.out or os.path.join(workdir,
+                                                f"{spec.name}.y.npy")
+            spec.record = spec.record or os.path.join(
+                workdir, f"{spec.name}.record.json")
+            spec.cache_dir = spec.cache_dir or cache_dir
+            spec.job_timeout = (self.job_timeout if spec.job_timeout is None
+                                else spec.job_timeout)
+            spec.stage_timeout = (self.stage_timeout
+                                  if spec.stage_timeout is None
+                                  else spec.stage_timeout)
+            self.jobs.append(_JobState(
+                spec=spec, index=i, plan=job_plan(spec, backend),
+                chaos=by_job.get(i, [])))
+        # fleet-level tallies (the counters also land in obs metrics)
+        self.max_running = 0
+        self.queue_depth_max = 0
+        self.chaos_log: list = []
+
+    # ---- child launch ------------------------------------------------------
+
+    def _attempt_fault_plan(self, job: _JobState) -> str | None:
+        """The child's TSNE-grammar plan for this attempt: fleet chaos
+        clauses (attempt 1 only) translated via FLEET_KIND_PLAN, joined
+        with the job's own process-local plan."""
+        parts = []
+        if job.attempts == 0:
+            for f in job.chaos:
+                parts.append(faults.FLEET_KIND_PLAN[f.kind])
+                self.chaos_log.append(
+                    {"clause": f"{f.kind}@job:{f.trigger}",
+                     "job": job.spec.name, "attempt": job.attempts + 1,
+                     "injected": parts[-1]})
+        if job.spec.fault_plan:
+            parts.append(job.spec.fault_plan)
+        return ",".join(parts) or None
+
+    def _launch(self, job: _JobState, elapsed: float) -> None:
+        spec_path = os.path.join(
+            self.workdir,
+            f"{job.spec.name}.attempt{job.attempts + 1}.json")
+        plan = self._attempt_fault_plan(job)
+        spec = JobSpec.from_dict({**job.spec.as_dict(),
+                                  "fault_plan": plan})
+        spec.save(spec_path)
+        env = dict(os.environ)
+        env.update(self.env)
+        # the fleet's own chaos plan is scheduler-level; a child must
+        # only ever see the per-attempt plan written into its spec
+        env.pop("TSNE_FAULT_PLAN", None)
+        # fleet identity: every record the child emits (per-job record,
+        # bench 'fleet' key) names the scheduling context it ran under
+        env["TSNE_FLEET_JOB"] = json.dumps({
+            "name": job.spec.name, "index": job.index,
+            "attempt": job.attempts + 1,
+            "budget_bytes": self.budget_bytes,
+            "predicted_peak": job.peak})
+        job.log_path = os.path.join(
+            self.workdir, f"{job.spec.name}.attempt{job.attempts + 1}.log")
+        with open(job.log_path, "wb") as logf:
+            job.proc = subprocess.Popen(
+                [sys.executable, "-m", "tsne_flink_tpu.runtime.fleet",
+                 "--job", spec_path],
+                stdout=logf, stderr=subprocess.STDOUT, env=env)
+        job.attempts += 1
+        job.status = RUNNING
+        job.launched_at = elapsed
+        obtrace.instant("fleet.launch", cat="fleet", job=job.spec.name,
+                        attempt=job.attempts, pid=job.proc.pid,
+                        predicted_peak=job.peak)
+
+    # ---- scheduling passes -------------------------------------------------
+
+    def _pending(self):
+        return [j for j in self.jobs if j.status == PENDING]
+
+    def _running(self):
+        return [j for j in self.jobs if j.status == RUNNING]
+
+    def _in_use(self) -> int:
+        return sum(j.peak for j in self._running())
+
+    def _admit_pass(self, elapsed: float) -> None:
+        for job in self._pending():
+            if elapsed < job.not_before:
+                continue  # backoff window: waiting, not rejected
+            if (self.max_concurrent
+                    and len(self._running()) >= self.max_concurrent):
+                self._count_reject(job, "max-concurrent cap")
+                continue
+            decision = self.controller.decide(job.plan, self._in_use())
+            if decision.action == QUEUE:
+                self._count_reject(job, decision.reason)
+                continue
+            job.decision = decision.as_dict()
+            job.peak = decision.predicted_peak
+            job.counted_reject = False
+            if decision.overrides.get("assembly"):
+                job.spec.assembly = decision.overrides["assembly"]
+            obtrace.instant("fleet.admit", cat="fleet", job=job.spec.name,
+                            action=decision.action,
+                            predicted_peak=decision.predicted_peak,
+                            in_use=self._in_use())
+            if decision.action != "admit":
+                obmetrics.counter("fleet.admission_degrades").inc()
+            self._launch(job, elapsed)
+            self.max_running = max(self.max_running, len(self._running()))
+        depth = len(self._pending())
+        obmetrics.gauge("fleet.queue_depth").set(depth)
+        obmetrics.gauge("fleet.in_use_bytes").set(self._in_use())
+        self.queue_depth_max = max(self.queue_depth_max, depth)
+
+    def _count_reject(self, job: _JobState, reason: str) -> None:
+        if job.counted_reject:
+            return  # one rejection per (job, queue residence)
+        job.counted_reject = True
+        obmetrics.counter("fleet.admission_rejections").inc()
+        obtrace.instant("fleet.reject", cat="fleet", job=job.spec.name,
+                        reason=reason)
+
+    def _poll_pass(self, elapsed: float) -> bool:
+        """Reap finished children, backstop-kill deadline overruns;
+        True when any job changed state (capacity may have freed)."""
+        changed = False
+        for job in self._running():
+            rc = job.proc.poll()
+            if rc is None:
+                limit = job.spec.job_timeout
+                if limit and elapsed - job.launched_at > limit + 5.0:
+                    # the child's own watchdog should have fired; a child
+                    # hung before arming it (backend bring-up) is the
+                    # fleet's to preempt
+                    job.proc.kill()
+                    job.proc.wait()
+                    rc = EXIT_TIMEOUT
+                    obmetrics.counter("fleet.preemptions").inc()
+                    obtrace.instant("fleet.preempt", cat="fleet",
+                                    job=job.spec.name, kind="job-deadline")
+                else:
+                    continue
+            job.returncode = rc
+            job.seconds = round(elapsed - job.launched_at, 3)
+            changed = True
+            if rc == 0:
+                job.status = DONE
+                job.counted_reject = False
+                obmetrics.counter("fleet.jobs_completed").inc()
+                obtrace.instant("fleet.exit", cat="fleet",
+                                job=job.spec.name, returncode=rc,
+                                attempts=job.attempts)
+                continue
+            job.failure = ("timeout" if rc == EXIT_TIMEOUT
+                           else "killed" if rc < 0 else "error")
+            if rc == EXIT_TIMEOUT:
+                obmetrics.counter("fleet.preemptions").inc()
+            obtrace.instant("fleet.exit", cat="fleet", job=job.spec.name,
+                            returncode=rc, failure=job.failure,
+                            attempts=job.attempts)
+            if job.attempts <= self.retries:
+                delay = backoff_seconds(job.attempts - 1,
+                                        self.backoff_base,
+                                        self.backoff_cap,
+                                        token=job.spec.name)
+                job.status = PENDING
+                job.not_before = elapsed + delay
+                job.counted_reject = False
+                obmetrics.counter("fleet.retries").inc()
+                obtrace.instant("fleet.retry", cat="fleet",
+                                job=job.spec.name, attempt=job.attempts + 1,
+                                backoff_s=round(delay, 3))
+            else:
+                job.status = FAILED
+                obmetrics.counter("fleet.jobs_failed").inc()
+        return changed
+
+    # ---- run ---------------------------------------------------------------
+
+    def run(self) -> dict:
+        """Schedule every job to completion; returns the fleet record."""
+        sp = obtrace.begin("fleet.run", cat="fleet",
+                           jobs=len(self.jobs), budget=self.budget_bytes)
+        try:
+            self._admit_pass(sp.elapsed())
+            while self._running() or self._pending():
+                time.sleep(self.poll_s)
+                now = sp.elapsed()
+                if self._poll_pass(now) or self._pending():
+                    self._admit_pass(now)
+                if not self._running() and self._pending():
+                    # nothing running and nothing admissible: every
+                    # pending job is either backoff-gated (wait for it)
+                    # or over-budget against an EMPTY fleet — refuse to
+                    # spin forever on the latter
+                    waiting = [j for j in self._pending()
+                               if now < j.not_before]
+                    if not waiting:
+                        for job in self._pending():
+                            job.status = FAILED
+                            job.failure = "unschedulable"
+                            obmetrics.counter("fleet.jobs_failed").inc()
+        finally:
+            sp.end()
+        return self._record(sp.seconds)
+
+    def _record(self, seconds: float) -> dict:
+        jobs = []
+        for job in sorted(self.jobs, key=lambda j: j.index):
+            rec = None
+            try:
+                with open(job.record_path(), encoding="utf-8") as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                pass
+            jobs.append({
+                "name": job.spec.name, "index": job.index,
+                "status": job.status, "attempts": job.attempts,
+                "returncode": job.returncode, "failure": job.failure,
+                "seconds": job.seconds, "predicted_peak": job.peak,
+                "decision": job.decision, "out": job.spec.out,
+                "record": rec})
+        counters = obmetrics.snapshot()["counters"]
+        return {
+            "fleet": {
+                "backend": self.backend,
+                "budget_bytes": self.budget_bytes,
+                "jobs_total": len(self.jobs),
+                "completed": sum(j.status == DONE for j in self.jobs),
+                "failed": sum(j.status == FAILED for j in self.jobs),
+                "max_running": self.max_running,
+                "queue_depth_max": self.queue_depth_max,
+                "admission_rejections":
+                    int(counters.get("fleet.admission_rejections", 0)),
+                "preemptions": int(counters.get("fleet.preemptions", 0)),
+                "retries": int(counters.get("fleet.retries", 0)),
+                "seconds": round(seconds, 3),
+            },
+            "chaos": self.chaos_log,
+            "jobs": jobs,
+            "metrics": obmetrics.snapshot(),
+        }
+
+
+if __name__ == "__main__":
+    sys.exit(main())

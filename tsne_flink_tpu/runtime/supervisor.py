@@ -17,7 +17,9 @@ estimator kwargs of the same names).
 
 from __future__ import annotations
 
+import hashlib
 import sys
+import time
 
 from tsne_flink_tpu.obs import metrics as obmetrics
 from tsne_flink_tpu.obs import trace as obtrace
@@ -34,6 +36,28 @@ def is_oom(exc: BaseException) -> bool:
     """True for device allocation failures (real XlaRuntimeError or the
     injected synthetic) — the only exception class the ladder handles."""
     return any(m in str(exc) for m in _OOM_MARKERS)
+
+
+def backoff_seconds(attempt: int, base: float | None = None,
+                    cap: float | None = None, token: str = "") -> float:
+    """Exponential backoff with DETERMINISTIC jitter for relaunch attempt
+    ``attempt`` (0-based): ``min(base * 2^attempt, cap)`` scaled by a
+    factor in [0.5, 1.0] derived from sha256(token:attempt) — so same
+    plan + same run = same sleep schedule (the ladder-determinism
+    contract extends to timing), while distinct tokens (fleet job names)
+    still decorrelate their retry storms.  ``base``/``cap`` default to
+    the ``TSNE_RETRY_BACKOFF`` / ``TSNE_RETRY_BACKOFF_CAP`` registry
+    values; base <= 0 disables the sleep entirely."""
+    from tsne_flink_tpu.utils.env import env_float
+    base = float(env_float("TSNE_RETRY_BACKOFF")) if base is None else base
+    cap = (float(env_float("TSNE_RETRY_BACKOFF_CAP")) if cap is None
+           else cap)
+    if base <= 0:
+        return 0.0
+    raw = min(base * (2.0 ** int(attempt)), cap)
+    digest = hashlib.sha256(f"{token}:{int(attempt)}".encode()).hexdigest()
+    jitter = int(digest[:8], 16) / 0xFFFFFFFF
+    return raw * (0.5 + 0.5 * jitter)
 
 
 class LadderExhausted(RuntimeError):
@@ -54,7 +78,9 @@ class Supervisor:
 
     def __init__(self, plan=None, *, max_retries: int = 2,
                  on_oom: str = "ladder", health_check: bool = False,
-                 health_retries: int = 3, events: list | None = None):
+                 health_retries: int = 3, events: list | None = None,
+                 retry_backoff: float | None = None,
+                 retry_backoff_cap: float | None = None):
         if on_oom not in ("ladder", "fail"):
             raise ValueError(f"on_oom '{on_oom}' not defined (ladder | fail)")
         self.ladder = OomLadder(plan) if plan is not None else None
@@ -62,6 +88,10 @@ class Supervisor:
         self.on_oom = on_oom
         self.health_check = bool(health_check)
         self.health_retries = int(health_retries)
+        #: backoff base/cap seconds; None = the TSNE_RETRY_BACKOFF /
+        #: TSNE_RETRY_BACKOFF_CAP registry defaults (resolved per sleep)
+        self.retry_backoff = retry_backoff
+        self.retry_backoff_cap = retry_backoff_cap
         self.events: list = events if events is not None else []
         # last good optimizer snapshot, updated at checkpoint boundaries
         self._last = None
@@ -69,6 +99,24 @@ class Supervisor:
         self.last_telemetry = None
 
     # ---- shared ladder plumbing -------------------------------------------
+
+    def _backoff(self, stage: str, attempt: int) -> None:
+        """Sleep the attempt's exponential-backoff delay before the
+        relaunch (immediate relaunch was the pre-fleet behavior: a real
+        device OOM often needs the allocator a beat to actually release).
+        The sleep is a recorded obs span and a structured event, so the
+        wait is attributable and the determinism test can pin the
+        schedule without measuring wall clock."""
+        secs = backoff_seconds(attempt, self.retry_backoff,
+                               self.retry_backoff_cap, token=stage)
+        self.events.append({"type": "backoff", "stage": stage,
+                            "attempt": attempt, "seconds": round(secs, 4)})
+        obmetrics.counter("runtime.backoff").inc()
+        if secs <= 0:
+            return
+        with obtrace.span("supervisor.backoff", cat="runtime", stage=stage,
+                          attempt=attempt, seconds=secs):
+            time.sleep(secs)
 
     def _handle_oom(self, stage: str, exc: BaseException, attempt: int):
         """Record the OOM and pick the ladder step, or re-raise."""
@@ -91,6 +139,7 @@ class Supervisor:
         print(f"# supervisor: OOM in '{stage}' — {deg.action} "
               f"({deg.before!r} -> {deg.after!r}), relaunching the stage",
               file=sys.stderr)
+        self._backoff(stage, attempt)
         return deg
 
     @property
@@ -218,13 +267,20 @@ def supervised_embed(x, cfg, *, supervisor: Supervisor,
                      knn_refine=None, knn_blocks: int = 8, seed: int = 0,
                      sym_width=None, affinity_assembly=None,
                      artifact_cache=None, knn_autotune: bool = False,
-                     telemetry: bool = False):
+                     telemetry: bool = False, on_stage=None,
+                     checkpoint_cb=None):
     """Supervised single-device pipeline: ``models/tsne.tsne_embed`` with
     the supervisor wrapped around prepare and a segmented optimizer run
     (the sentinel needs segment boundaries to roll back to).  Same key
     derivation and prepare plan as ``tsne_embed``; the optimize loop runs
     through ``ShardedOptimizer`` on one device — the same compiled
-    program, segmented."""
+    program, segmented.
+
+    ``on_stage(name, seconds, cache_state)`` / ``checkpoint_cb(state,
+    next_iter, losses)`` are progress hooks at prepare-stage completions
+    and optimize segment boundaries — the fleet job runner feeds its
+    watchdog heartbeats through them; they never change a bit of the
+    result."""
     import jax
 
     from tsne_flink_tpu.models.tsne import LOSS_EVERY, init_working_set
@@ -249,7 +305,8 @@ def supervised_embed(x, cfg, *, supervisor: Supervisor,
                       key=kkey, perplexity=cfg.perplexity,
                       assembly=assembly, sym_width=sym_width,
                       cache=artifact_cache, knn_autotune=knn_autotune,
-                      knn_tiles=knn_tiles, on_stage=on_stage))
+                      knn_tiles=knn_tiles, on_stage=on_stage),
+        on_stage=on_stage)
 
     state = init_working_set(ikey, n, cfg.n_components, x.dtype)
     iters = cfg.iterations
@@ -257,6 +314,7 @@ def supervised_embed(x, cfg, *, supervisor: Supervisor,
     state, losses = supervisor.run_optimize(
         lambda c: ShardedOptimizer(c, n, n_devices=1), cfg, state,
         prep.jidx, prep.jval, extra_edges=prep.extra_edges,
-        checkpoint_every=seg, checkpoint_cb=lambda *a: None,
+        checkpoint_every=seg,
+        checkpoint_cb=checkpoint_cb or (lambda *a: None),
         telemetry=telemetry)
     return state.y, losses

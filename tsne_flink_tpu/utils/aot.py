@@ -214,6 +214,8 @@ def _load(root: str, label: str, key: str):
 
 def _save(root: str, label: str, key: str, compiled) -> bool:
     from jax.experimental import serialize_executable
+
+    from tsne_flink_tpu.utils.locks import FileLock
     try:
         payload, in_tree, out_tree = serialize_executable.serialize(compiled)
     except Exception:
@@ -222,21 +224,33 @@ def _save(root: str, label: str, key: str, compiled) -> bool:
              "in_tree": in_tree, "out_tree": out_tree}
     try:
         os.makedirs(root, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=root, suffix=".aot.tmp")
     except OSError:
         return False
-    try:
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(entry, f)
-        os.replace(tmp, _path(root, label, key))
-    except (OSError, pickle.PicklingError):
+    # cross-process write lock (utils/locks.py): two fleet jobs compiling
+    # the same plan-keyed executable serialize identical bytes — the
+    # loser skips instead of interleaving with the winner's rename
+    lock = FileLock(_path(root, label, key) + ".lock")
+    if not lock.acquire():
         return False
+    try:
+        try:
+            fd, tmp = tempfile.mkstemp(dir=root, suffix=".aot.tmp")
+        except OSError:
+            return False
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(entry, f)
+            os.replace(tmp, _path(root, label, key))
+        except (OSError, pickle.PicklingError):
+            return False
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
     finally:
-        if os.path.exists(tmp):
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        lock.release()
     return True
 
 

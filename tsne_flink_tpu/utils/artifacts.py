@@ -209,21 +209,36 @@ class ArtifactCache:
         path = self.path(kind, fp)
         try:
             os.makedirs(self.root, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".artifact.tmp")
         except OSError:
             return False  # unwritable root: the cache is best-effort
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, magic=MAGIC, fingerprint=fp, **arrays)
-            os.replace(tmp, path)
-        except OSError:
+        # cross-process write lock (utils/locks.py): concurrent fleet jobs
+        # preparing the same key must not interleave on one entry; the
+        # holder is writing these exact content-addressed bytes, so a
+        # timed-out wait is a skip, not a failure
+        from tsne_flink_tpu.utils.locks import FileLock
+        lock = FileLock(path + ".lock")
+        if not lock.acquire():
             return False
+        try:
+            try:
+                fd, tmp = tempfile.mkstemp(dir=self.root,
+                                           suffix=".artifact.tmp")
+            except OSError:
+                return False
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez(f, magic=MAGIC, fingerprint=fp, **arrays)
+                os.replace(tmp, path)
+            except OSError:
+                return False
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
         finally:
-            if os.path.exists(tmp):
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+            lock.release()
         return True
 
 

@@ -16,7 +16,7 @@ import json
 import os
 import sys
 
-from tsne_flink_tpu.utils.env import env_bool, env_str
+from tsne_flink_tpu.utils.env import env_bool, env_float, env_str
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -198,6 +198,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(runtime/faults.py grammar, e.g. "
                         "'oom@knn:1,kill@optimize:seg2'); same as "
                         "$TSNE_FAULT_PLAN")
+    p.add_argument("--jobTimeout", type=float, default=None,
+                   help="wall-clock seconds this run may take before the "
+                        "runtime watchdog (runtime/fleet.Watchdog) "
+                        "terminates the process with exit code 124 — the "
+                        "per-job limit fleet jobs inherit. Env twin: "
+                        "$TSNE_JOB_TIMEOUT; unset/0 = no limit")
+    p.add_argument("--stageTimeout", type=float, default=None,
+                   help="wall-clock seconds between run heartbeats "
+                        "(prepare stage completions, optimize segment "
+                        "boundaries) before the watchdog terminates the "
+                        "process with exit code 124 — a hung or "
+                        "chaos-delayed stage dies instead of eating the "
+                        "window. Env twin: $TSNE_STAGE_TIMEOUT; give "
+                        "--checkpointEvery to get intra-optimize beats")
     p.add_argument("--auditPlan", nargs="?", const="fail", default=None,
                    choices=["fail", "warn"],
                    help="run the graftcheck plan audit (static per-stage "
@@ -448,6 +462,20 @@ def _payload_with_events(prepare_payload, supervisor, prior):
     return payload
 
 
+def _with_beat(wd, cb):
+    """Wrap a checkpoint callback so every optimize segment boundary also
+    heartbeats the run watchdog (--stageTimeout); identity when no
+    watchdog is armed, and a pure beat when there is no callback."""
+    if wd is None:
+        return cb
+
+    def beat_cb(st, next_iter, losses):
+        wd.beat("optimize")
+        if cb is not None:
+            cb(st, next_iter, losses)
+    return beat_cb
+
+
 def _make_checkpoint_cb(args, prepare_payload=None, supervisor=None,
                         prior_events=None):
     """Periodic-checkpoint callback for --checkpoint/--checkpointEvery."""
@@ -497,11 +525,18 @@ def _write_obs_outputs(trace_path, metrics_path, telemetry=None) -> None:
               file=sys.stderr)
 
 
+#: the run watchdog (--jobTimeout/--stageTimeout), installed by _main and
+#: ALWAYS stopped by main()'s finally — a leaked watchdog thread would
+#: os._exit a later in-process caller mid-run.
+_WATCHDOG = None
+
+
 def main(argv=None) -> int:
     """Arg parse + dispatch.  Wraps :func:`_main` so the trace-time
     mixed-precision setting (--dtype bfloat16) — and the obs tracer
     enablement — cannot leak into a later in-process caller (tests call
     main() directly)."""
+    global _WATCHDOG
     from tsne_flink_tpu.obs import trace as obtrace
     from tsne_flink_tpu.ops.metrics import matmul_dtype, set_matmul_dtype
     from tsne_flink_tpu.utils import aot
@@ -518,6 +553,9 @@ def main(argv=None) -> int:
         return _main(argv, sp_run)
     finally:
         sp_run.end()
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
+            _WATCHDOG = None
         set_matmul_dtype(prev)
         aot.set_enabled(prev_aot)
         obtrace.set_enabled(prev_trace)
@@ -568,6 +606,22 @@ def _main(argv=None, sp_run=None) -> int:
         # site runs (same grammar/effect as $TSNE_FAULT_PLAN)
         from tsne_flink_tpu.runtime import faults
         faults.activate(args.faultPlan)
+
+    # wall-clock limits (graftfleet watchdog): --jobTimeout caps the whole
+    # run, --stageTimeout the gap between heartbeats (prepare stage
+    # completions, optimize segment boundaries — give --checkpointEvery
+    # for intra-optimize beats); either limit exceeded terminates the
+    # process with exit code 124.  main()'s finally stops the thread so
+    # in-process callers can never be killed by a stale watchdog.
+    global _WATCHDOG
+    job_to = (args.jobTimeout if args.jobTimeout is not None
+              else env_float("TSNE_JOB_TIMEOUT"))
+    stage_to = (args.stageTimeout if args.stageTimeout is not None
+                else env_float("TSNE_STAGE_TIMEOUT"))
+    wd = None
+    if job_to or stage_to:
+        from tsne_flink_tpu.runtime.fleet import Watchdog
+        wd = _WATCHDOG = Watchdog(job_to, stage_to, label="cli.run").start()
 
     multihost = (args.coordinator, args.numProcesses, args.processId)
     if any(v is not None for v in multihost):
@@ -786,7 +840,7 @@ def _main(argv=None, sp_run=None) -> int:
                 spmd_data, key, start_iter=start_iter, loss_carry=loss_carry,
                 resume_state=resume_state,
                 checkpoint_every=args.checkpointEvery,
-                checkpoint_cb=_make_checkpoint_cb(args),
+                checkpoint_cb=_with_beat(wd, _make_checkpoint_cb(args)),
                 health_check=args.healthCheck,
                 events=supervisor.events,
                 telemetry=args.telemetry)
@@ -885,7 +939,8 @@ def _main(argv=None, sp_run=None) -> int:
         prep = supervisor.run_prepare(
             lambda on_stage, **ov: art.prepare(
                 cache=art_cache, knn_autotune=args.knnAutotune,
-                on_stage=on_stage, **{**prep_kwargs, **ov}))
+                on_stage=on_stage, **{**prep_kwargs, **ov}),
+            on_stage=(lambda st, secs, cs: wd.beat(st)) if wd else None)
         jidx, jval = prep.jidx, prep.jval
         extra_edges, label = prep.extra_edges, prep.label
         affinity_fp = prep.affinity_fp
@@ -941,8 +996,8 @@ def _main(argv=None, sp_run=None) -> int:
                                        aot_plan=run_plan)),
         cfg, state, jidx, jval, start_iter=start_iter,
         loss_carry=loss_carry, checkpoint_every=args.checkpointEvery,
-        checkpoint_cb=_make_checkpoint_cb(args, save_payload, supervisor,
-                                          prior_events),
+        checkpoint_cb=_with_beat(wd, _make_checkpoint_cb(
+            args, save_payload, supervisor, prior_events)),
         extra_edges=extra_edges, telemetry=args.telemetry)
     state.y.block_until_ready()
     if args.profile:

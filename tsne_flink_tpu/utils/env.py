@@ -104,8 +104,10 @@ _declare("TSNE_FAULT_PLAN", "str", None,
          "'oom@knn:1,kill@optimize:seg2,corrupt@checkpoint'. Kinds: oom "
          "(synthetic RESOURCE_EXHAUSTED), kill (SIGKILL at a segment "
          "boundary), corrupt (bit-flip the just-written checkpoint), nan "
-         "(poison a segment's input state). Testing only; unset in "
-         "production.")
+         "(poison a segment's input state), delay (sleep "
+         "TSNE_FAULT_DELAY_S at the site — latency chaos). Fleet chaos "
+         "plans additionally take kind@job:N clauses (runtime/fleet.py). "
+         "Testing only; unset in production.")
 _declare("TSNE_ON_OOM", "str", "ladder",
          "Bench default for the supervisor's device-OOM policy: 'ladder' "
          "degrades the plan (runtime/ladder.py: shrink kNN tiles -> blocks "
@@ -120,6 +122,50 @@ _declare("TSNE_HEALTH_CHECK", "bool", False,
          "--healthCheck): per-segment on-device finite-check on (Y, gains, "
          "KL); a non-finite segment rolls back to the last good state and "
          "retries with halved eta and a fresh momentum buffer.")
+_declare("TSNE_RETRY_BACKOFF", "float", 0.25,
+         "Base seconds of the supervisor/fleet exponential retry backoff: "
+         "relaunch attempt i sleeps min(base * 2^i, cap) scaled by a "
+         "deterministic jitter in [0.5, 1.0] derived from the retry token "
+         "(runtime/supervisor.backoff_seconds). 0 disables the sleep.")
+_declare("TSNE_RETRY_BACKOFF_CAP", "float", 30.0,
+         "Cap seconds on one supervisor/fleet retry-backoff sleep.")
+_declare("TSNE_FAULT_DELAY_S", "float", 2.0,
+         "Seconds a delay@site fault clause (runtime/faults.py) sleeps at "
+         "the instrumented site — the latency-injection twin of oom/kill "
+         "for chaos plans; the sleep is wrapped in a fault.delay obs span.")
+_declare("TSNE_JOB_TIMEOUT", "float", None,
+         "Wall-clock seconds one embed job may run before the runtime "
+         "watchdog (runtime/fleet.Watchdog) terminates the process with "
+         "exit code 124 (the CLI's --jobTimeout; fleet jobs inherit it "
+         "from FleetConfig and the fleet additionally backstop-kills). "
+         "Unset/0 = no limit.")
+_declare("TSNE_STAGE_TIMEOUT", "float", None,
+         "Wall-clock seconds between watchdog heartbeats (prepare stage "
+         "completions, optimize segment boundaries) before the process is "
+         "terminated with exit code 124 (the CLI's --stageTimeout) — a "
+         "hung or chaos-delayed stage dies instead of eating the job "
+         "window. Unset/0 = no limit.")
+
+# ---- graftfleet (tsne_flink_tpu/runtime/fleet.py) ---------------------------
+_declare("TSNE_FLEET_HBM_BUDGET", "int", None,
+         "Fleet admission budget in bytes: concurrent jobs are admitted "
+         "only while the sum of their graftcheck-predicted per-stage peak "
+         "HBM (analysis/audit/hbm.py) stays within it. Default: the "
+         "backend's device budget (HBM_BUDGET_BYTES) when one exists, "
+         "else unlimited.")
+_declare("TSNE_FLEET_MAX_JOBS", "int", 0,
+         "Hard cap on concurrently running fleet jobs (0 = no count cap; "
+         "the HBM budget still gates admission).")
+_declare("TSNE_FLEET_JOB", "str", None,
+         "Set by the fleet scheduler on every child it launches: a JSON "
+         "blob {name, index, attempt, budget_bytes, predicted_peak} that "
+         "rides the child's records (bench 'fleet' key, per-job record), "
+         "so a number produced under fleet co-residency can never be "
+         "mistaken for a solo run's. Internal; never set it by hand.")
+_declare("TSNE_LOCK_STALE_S", "float", 60.0,
+         "Age in seconds after which a cross-process cache lock file "
+         "(utils/locks.py) is considered abandoned (writer died mid-hold) "
+         "and is broken by the next acquirer.")
 
 # ---- caches ----------------------------------------------------------------
 _declare("TSNE_ARTIFACTS", "bool", True,

@@ -1,0 +1,128 @@
+"""Cross-process file locks for the shared caches.
+
+Concurrent fleet jobs (``runtime/fleet.py``) share the content-addressed
+artifact cache (``utils/artifacts.py``) and the AOT executable cache
+(``utils/aot.py``).  Both stores already write atomically (tmp + rename),
+so readers never see torn entries — but two processes preparing the SAME
+cache key still interleave: both pay the compute, both serialize, and the
+loser's rename clobbers the winner's identical bytes while a third
+process may be mid-``load`` of the first.  :class:`FileLock` serializes
+the write side per cache key with the oldest portable primitive there is:
+
+* **acquire** = ``os.open(path, O_CREAT | O_EXCL)`` — atomic on every
+  POSIX filesystem; the file body records ``pid`` for post-mortems;
+* **stale-lock timeout** — a writer that died mid-hold (SIGKILL chaos is
+  a first-class citizen here) leaves its lock behind; any acquirer that
+  finds a lock older than ``TSNE_LOCK_STALE_S`` breaks it and retries,
+  so an abandoned lock costs one timeout, never a deadlock;
+* **bounded wait** — :meth:`acquire` polls up to ``timeout_s`` and then
+  returns False instead of raising: for content-addressed writes the
+  holder is producing the SAME bytes, so "someone else is writing this
+  entry" is a reason to skip, not to fail.
+
+Usage (the cache-write pattern; release via try/finally — the
+``resource-hygiene`` lint rule checks exactly this shape)::
+
+    lock = FileLock(path + ".lock")
+    if lock.acquire(timeout_s=5.0):
+        try:
+            ...tmp + rename write...
+        finally:
+            lock.release()
+
+Pure stdlib; the only clock is ``obs.trace.walltime`` (lock age and wait
+deadlines are wall-clock arithmetic, not timing — see its docstring).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from tsne_flink_tpu.obs.trace import walltime
+from tsne_flink_tpu.utils.env import env_float
+
+#: suffix every cache lock file carries (tests sweep for leftovers).
+LOCK_SUFFIX = ".lock"
+
+#: default bounded wait of :meth:`FileLock.acquire` (seconds) — long
+#: enough to ride out a concurrent same-key write, short enough that a
+#: best-effort cache skip never stalls a pipeline stage.
+DEFAULT_TIMEOUT_S = 5.0
+
+
+class FileLock:
+    """One advisory cross-process lock backed by an O_EXCL lock file."""
+
+    def __init__(self, path: str, stale_s: float | None = None,
+                 poll_s: float = 0.02):
+        self.path = path
+        self.stale_s = (float(env_float("TSNE_LOCK_STALE_S"))
+                        if stale_s is None else float(stale_s))
+        self.poll_s = float(poll_s)
+        self._held = False
+
+    # ---- protocol ----------------------------------------------------------
+
+    def _try_once(self) -> bool:
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            # unwritable root: behave like "never acquired" — the caches
+            # are best-effort and their writes already tolerate skipping
+            return False
+        try:
+            os.write(fd, f"pid={os.getpid()}\n".encode())
+        finally:
+            os.close(fd)
+        self._held = True
+        return True
+
+    def _break_if_stale(self) -> None:
+        try:
+            age = walltime() - os.path.getmtime(self.path)
+        except OSError:
+            return  # holder released between our check and the stat
+        if age > self.stale_s:
+            try:
+                os.remove(self.path)  # break: the writer died mid-hold
+            except OSError:
+                pass  # another waiter broke it first — same outcome
+
+    def acquire(self, timeout_s: float | None = None) -> bool:
+        """True when the lock is held; False after ``timeout_s`` of
+        polling (the holder is still alive and working)."""
+        if timeout_s is None:
+            timeout_s = DEFAULT_TIMEOUT_S
+        deadline = walltime() + float(timeout_s)
+        while True:
+            if self._try_once():
+                return True
+            self._break_if_stale()
+            if walltime() >= deadline:
+                return False
+            time.sleep(self.poll_s)
+
+    def release(self) -> None:
+        if not self._held:
+            return
+        self._held = False
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass  # broken as stale by a waiter: already gone
+
+    # ---- context form (raises when the lock cannot be had) -----------------
+
+    def __enter__(self) -> "FileLock":
+        # graftlint: disable=resource-hygiene -- __enter__ IS the
+        # context-manager acquisition; __exit__ below is the release
+        if not self.acquire():
+            raise TimeoutError(f"could not acquire {self.path}")
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
