@@ -1,0 +1,29 @@
+"""Seeded violations for the mesh-hygiene rule (path makes it package
+scope): raw axis-name literals, pmap, and PartitionSpec construction
+outside parallel/mesh.py.  The word "points" in this docstring is prose
+and must NOT fire."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from tsne_flink_tpu.parallel.mesh import AXIS
+
+
+def bad_axis_literal(x):
+    return jax.lax.psum(x, "points")  # VIOLATION: raw axis-name literal
+
+
+def bad_pmap(fn):
+    return jax.pmap(fn)  # VIOLATION: pmap outside the mesh module
+
+
+def bad_partition_spec():
+    return P("points")  # VIOLATION x2: construction AND the raw literal
+
+
+def good_axis(x):
+    return jax.lax.psum(x, AXIS)  # imported AXIS: clean
+
+
+def suppressed(x):
+    return jax.pmap(x)  # graftlint: disable=mesh-hygiene -- seeded twin: suppression must silence

@@ -107,9 +107,10 @@ def test_sharded_optimizer_edge_layout_matches_rows():
 
 
 def test_fused_pipeline_escalation_uses_edges_and_matches_rows():
-    """Hub graph through the fused SpmdPipeline: the auto sym_width guess
-    overflows, the recompiled program sizes the flat edge layout from the
-    measured nnz, and the result matches a pinned-wide rows-layout run."""
+    """Hub graph through the SpmdPipeline wrapper: the auto sym_width guess
+    overflows, the prepare pass escalates to the measured width, and the
+    unified optimizer (graftmesh) routes the hub-widened rows to the flat
+    edge layout — matching a pinned-wide rows-layout run."""
     n, k = 96, 6
     idx, dist = _graph(n, k, seed=4, hub=True)
     cfg_e = TsneConfig(iterations=10, repulsion="exact", exact_impl="xla")
@@ -117,7 +118,10 @@ def test_fused_pipeline_escalation_uses_edges_and_matches_rows():
                         n_devices=8)
     y_e, l_e = pipe((idx, dist), jax.random.key(7))
     assert pipe._escalations >= 1, "hub graph must overflow the auto width"
-    assert pipe._edge_pad is not None, "escalated run must size the edge layout"
+    # the unified optimizer's layout decision: hub-widened rows -> edges
+    jidx, jval, _ = pipe.prepare((idx, dist), jax.random.key(7))
+    layout, _, _ = pipe._runner.attraction_plan(jidx, jval)
+    assert layout == "edges", "hub-widened rows must take the edge layout"
 
     cfg_r = TsneConfig(iterations=10, repulsion="exact", exact_impl="xla",
                        attraction="rows")
@@ -130,8 +134,8 @@ def test_fused_pipeline_escalation_uses_edges_and_matches_rows():
 
 def test_fused_pipeline_explicit_edges_without_escalation():
     """attraction='edges' must engage the edge layout even when the auto
-    sym_width never overflows (uniform graph): the pipeline pays one
-    prep-only pass to size the pad, then matches the rows run."""
+    sym_width never overflows (uniform graph): since graftmesh the unified
+    optimizer sizes the host-side edge layout itself, no prep pass."""
     n, k = 80, 5
     idx, dist = _graph(n, k, seed=6, hub=False)
     cfg_e = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla",
@@ -139,7 +143,9 @@ def test_fused_pipeline_explicit_edges_without_escalation():
     pipe = SpmdPipeline(cfg_e, n, 0, k, knn_method="precomputed", n_devices=8)
     y_e, l_e = pipe((idx, dist), jax.random.key(2))
     assert pipe._escalations == 0, "uniform graph must not overflow"
-    assert pipe._edge_pad is not None, "explicit edges must size the layout"
+    jidx, jval, _ = pipe.prepare((idx, dist), jax.random.key(2))
+    layout, _, _ = pipe._runner.attraction_plan(jidx, jval)
+    assert layout == "edges", "explicit edges must engage the layout"
 
     cfg_r = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla",
                        attraction="rows")
@@ -151,23 +157,22 @@ def test_fused_pipeline_explicit_edges_without_escalation():
 
 
 def test_fused_pipeline_edge_pad_refreshes_on_denser_graph():
-    """A pipeline whose _edge_pad was sized on one dataset must refresh it
-    when rerun on a denser graph of the same shapes — an undersized pad
-    would silently drop edges (code-review r3 finding)."""
+    """A pipeline reused on a DENSER graph of the same shapes must never
+    drop edges (the code-review r3 stale-pad finding): since graftmesh the
+    unified optimizer sizes the edge layout fresh from each run's rows, so
+    the rerun must match a fresh rows-layout pipeline exactly as the first
+    run did."""
     n, k = 96, 6
     idx1, dist1 = _graph(n, k, seed=4, hub=True)
     cfg = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla")
     pipe = SpmdPipeline(cfg, n, 0, k, knn_method="precomputed", n_devices=8)
     pipe((idx1, dist1), jax.random.key(7))
-    pad1 = pipe._edge_pad
-    assert pad1 is not None
 
     # denser: EVERY row points at the first 3 hubs -> far more edges
     idx2 = np.asarray(idx1).copy()
     idx2[3:, :3] = [0, 1, 2]
     idx2 = jnp.asarray(idx2)
     y2, l2 = pipe((idx2, dist1), jax.random.key(7))
-    assert pipe._edge_pad >= pad1
 
     cfg_r = TsneConfig(iterations=8, repulsion="exact", exact_impl="xla",
                        attraction="rows")

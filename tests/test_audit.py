@@ -103,6 +103,32 @@ def test_1m_blocks_plan_passes():
     assert findings == []
 
 
+def test_1m_blocks_v5e8_mesh_plan_per_device_peak():
+    """graftmesh: the v5e-8 mesh variant of the 1M blocks plan predicts a
+    PER-DEVICE peak under the budget, with the optimize stage scaled by
+    the 8-wide point mesh (row-sharded terms at n/8) while the gathered
+    [N, m] embedding and the full-N tile columns stay whole — the
+    auditor now picks the cheapest feasible plan per MESH."""
+    one = fixture_plan("plan_1m_blocks.json")
+    v5e8 = fixture_plan("plan_1m_blocks_v5e8.json")
+    assert v5e8.mesh == 8 and one.mesh == 1
+    findings, reports = audit_hbm([v5e8])
+    rep = reports[v5e8.name]
+    assert findings == []
+    assert rep["ok"] and rep["peak_hbm_est"] <= V5E_BUDGET
+    assert rep["mesh"] == 8
+    r1 = plan_hbm_report(one)
+    # the sharded optimize stage must be strictly cheaper per device, but
+    # NOT a naive /8: the gathered embedding + full-N columns stay whole
+    opt8 = rep["stages"]["optimize"]["peak"]
+    opt1 = r1["stages"]["optimize"]["peak"]
+    assert opt8 < opt1
+    assert opt8 > opt1 / 8
+    assert rep["stages"]["optimize"]["mesh"] == "8"
+    # prepare is host-staged in the unified pipeline: not mesh-scaled
+    assert rep["stages"]["knn"] == r1["stages"]["knn"]
+
+
 def test_materialized_padding_term_is_visible():
     """The root-caused band-sweep difference (two dead full-input copies)
     must show up as ~2x the input bytes between the two fixture plans'

@@ -24,8 +24,10 @@ REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data",
             "assembly", "cache",  # self-describing records (ADVICE r5 #1)
             "memory", "host_calib",  # obsgraft: predicted-vs-observed HBM
                                      # + host-calibration on EVERY record
-            "fleet"}  # graftfleet context: None solo, the scheduler's
+            "fleet",  # graftfleet context: None solo, the scheduler's
                       # {name, index, attempt, budget, peak} under a fleet
+            "mesh"}   # graftmesh: the resolved {devices, axis, pad_quantum}
+                      # mesh the optimize loop sharded over
 
 
 def run_bench(n, iters, extra_env=None, timeout=600):
@@ -44,7 +46,7 @@ def run_bench(n, iters, extra_env=None, timeout=600):
                  "TSNE_BENCH_MARGIN_S", "TSNE_BENCH_SEG",
                  "TSNE_ARTIFACT_DIR", "TSNE_AFFINITY_ASSEMBLY",
                  "TSNE_TUNNEL_DOWN", "TSNE_KNN_AUTOTUNE",
-                 "TSNE_TELEMETRY", "TSNE_FLEET_JOB"):
+                 "TSNE_TELEMETRY", "TSNE_FLEET_JOB", "TSNE_MESH"):
         env.pop(knob, None)
     env.update(extra_env or {})
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
@@ -99,6 +101,28 @@ def test_final_record_carries_resolved_assembly_and_cache():
     assert final["cache"] == "off"  # hermetic default in run_bench
     assert final["matmul_dtype"] == "float32"  # cpu run: no bf16 default
     assert final["fleet"] is None  # standalone bench: no fleet context
+    # graftmesh: the resolved mesh rides every record, and the peak_flops
+    # basis records the SAME width the optimize loop sharded over (on
+    # TPU the peak scales with it; on CPU virtual devices share the
+    # cores, so the basis carries the mesh as an annotation instead)
+    mesh = final["mesh"]
+    assert mesh["axis"] == "points" and mesh["devices"] >= 1
+    assert "pad_quantum" in mesh
+    if mesh["devices"] > 1:
+        assert f"mesh {mesh['devices']}" in final["peak_flops_basis"]
+
+
+def test_mesh_env_pins_width():
+    """TSNE_MESH=1 on the (virtual 8-device) test host: the record says a
+    1-wide mesh while the host still reports its real device count, and
+    the CPU peak basis never multiplies by virtual devices."""
+    one = run_bench(800, 20, {"TSNE_MESH": "1"})[-1]
+    assert one["mesh"]["devices"] == 1
+    allw = run_bench(800, 20)[-1]
+    assert allw["mesh"]["devices"] == allw["devices"]
+    # CPU: same silicon either way — the peak must NOT scale with the
+    # virtual mesh (a TPU mesh does scale; asserted in the flops tests)
+    assert one["peak_flops"] == allw["peak_flops"]
 
 
 def test_fleet_context_rides_records_when_scheduled():

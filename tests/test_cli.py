@@ -165,17 +165,25 @@ def test_knn_autotune_flag_parses():
 
 
 @pytest.mark.parametrize("assembly", ["auto", "sorted", "split", "blocks"])
-def test_cli_rejects_any_assembly_with_spmd(tmp_path, assembly):
-    # ADVICE r5 #2: models/api.py refuses ANY explicit assembly override
-    # with spmd=True; the CLI used to refuse only 'blocks', silently
-    # ignoring the rest — so a builder A/B under --spmd measured the wrong
-    # path.  Now every explicit value is rejected before any parsing.
+def test_cli_assembly_composes_with_spmd_alias(tmp_path, assembly, capsys):
+    # graftmesh deleted the old --spmd-rejects---affinityAssembly guard:
+    # --spmd is now a deprecated alias of --mesh, the single-controller
+    # run goes through the unified host-staged prepare, and EVERY
+    # assembly override genuinely applies (the seam the guard papered
+    # over is gone).
     tmp = str(tmp_path)
-    path, _ = blob_csv(tmp, n=10, d=4)
-    with pytest.raises(SystemExit):
-        main(["--input", path, "--output", os.path.join(tmp, "o.csv"),
-              "--dimension", "4", "--knnMethod", "bruteforce", "--spmd",
-              "--affinityAssembly", assembly])
+    path, _ = blob_csv(tmp, n=20, d=4)
+    rc = main(["--input", path, "--output", os.path.join(tmp, "o.csv"),
+               "--dimension", "4", "--knnMethod", "bruteforce", "--spmd",
+               "--perplexity", "4", "--iterations", "10",
+               "--dtype", "float64", "--noCache",
+               "--loss", os.path.join(tmp, "l.txt"),
+               "--affinityAssembly", assembly])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "--spmd is deprecated" in err
+    out = np.loadtxt(os.path.join(tmp, "o.csv"), delimiter=",", ndmin=2)
+    assert out.shape == (20, 3) and np.isfinite(out).all()
 
 
 def test_cli_warm_cache_rerun_bit_identical(tmp_path):

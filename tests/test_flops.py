@@ -80,6 +80,20 @@ def test_peak_flops_known_kinds():
     assert "unrecognized" in basis_g
 
 
+def test_peak_flops_scales_with_mesh():
+    """graftmesh: `devices` is the MESH width — TPU peaks multiply (each
+    mesh device is real silicon), CPU peaks do NOT (virtual devices share
+    the cores) but the basis records the mesh."""
+    p1, _ = peak_flops("tpu", "TPU v5 lite", 1)
+    p8, basis8 = peak_flops("tpu", "TPU v5 lite", 8)
+    assert p8 == pytest.approx(8 * p1)
+    assert "x 8" in basis8
+    c1, _ = peak_flops("cpu", devices=1, cpu_cores=4)
+    c8, basis_c8 = peak_flops("cpu", devices=8, cpu_cores=4)
+    assert c8 == c1  # same silicon: an 8-wide virtual mesh is not 8x peak
+    assert "mesh 8" in basis_c8 and "not multiplied" in basis_c8
+
+
 def test_unknown_backends_raise():
     with pytest.raises(ValueError):
         knn_flops(100, 10, 5, "nope")

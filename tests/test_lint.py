@@ -61,7 +61,7 @@ def test_all_rules_registered():
                           "dtype-drift", "bench-record-contract",
                           "cli-api-parity", "audit-contract",
                           "exception-hygiene", "timing-hygiene",
-                          "resource-hygiene"}
+                          "resource-hygiene", "mesh-hygiene"}
 
 
 # ---- every fixture violation is found, suppressions silence ---------------
@@ -78,6 +78,7 @@ FIXTURE_FOR_RULE = {
     "timing-hygiene": os.path.join("tsne_flink_tpu",
                                    "fx_timing_hygiene.py"),
     "resource-hygiene": os.path.join("runtime", "fx_resource_hygiene.py"),
+    "mesh-hygiene": os.path.join("tsne_flink_tpu", "fx_mesh_hygiene.py"),
 }
 
 

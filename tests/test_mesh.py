@@ -1,0 +1,286 @@
+"""graftmesh acceptance (ISSUE 9): ONE mesh-parametric pipeline.
+
+The contracts pinned here, all CPU-only on the 8-virtual-device mesh:
+
+* ``MeshPlan`` padding/spec layout units: every mesh width dividing the
+  padding quantum pads N to the SAME length, so the programs share
+  shapes;
+* mesh ∈ {1, 4} × {health, telemetry}: the sharded optimizer produces
+  BIT-IDENTICAL state and losses at every segment boundary and at the
+  end — the portable-checkpoint contract rides on this;
+* fat v2 checkpoint portability: a checkpoint written on a 1-device mesh
+  resumes bit-identically on a 4-virtual-device CPU mesh and vice versa
+  (real CLI subprocesses with ``--xla_force_host_platform_device_count=4``);
+* the supervisor's OOM ladder and the divergence sentinel run unmodified
+  against the unified pipeline on a non-trivial mesh;
+* ``TSNE(mesh=4)`` equals ``TSNE(mesh=1)`` bit for bit.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.models.tsne import TsneConfig, TsneState
+from tsne_flink_tpu.ops.affinities import (joint_distribution,
+                                           pairwise_affinities)
+from tsne_flink_tpu.ops.knn import knn_bruteforce
+from tsne_flink_tpu.parallel.mesh import (PAD_QUANTUM, MeshPlan,
+                                          ShardedOptimizer, padded_rows_for)
+from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.fast
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def problem(n=45, seed=0, k=8, perplexity=4.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, 6)) * 4.0
+    x = centers[rng.integers(0, 3, n)] + rng.normal(size=(n, 6))
+    idx, dist = knn_bruteforce(jnp.asarray(x), k)
+    p = pairwise_affinities(dist, perplexity)
+    jidx, jval = joint_distribution(idx, p)
+    y0 = rng.normal(size=(n, 2)) * 1e-4
+    st = TsneState(y=jnp.asarray(y0), update=jnp.zeros_like(jnp.asarray(y0)),
+                   gains=jnp.ones_like(jnp.asarray(y0)))
+    return st, jidx, jval
+
+
+# ---- MeshPlan / padding units ----------------------------------------------
+
+def test_mesh_plan_padding_is_width_invariant_within_quantum():
+    """Widths dividing the quantum pad N identically — the shape equality
+    the bit-identity contract rides on."""
+    for n in (1, 7, 45, 48, 10_000, 59_999):
+        pads = {d: padded_rows_for(n, d) for d in (1, 2, 4, 8)}
+        assert len(set(pads.values())) == 1, (n, pads)
+        assert pads[1] % PAD_QUANTUM == 0 and pads[1] >= n
+        assert pads[1] - n < PAD_QUANTUM
+    # a width beyond the quantum still divides its own padding
+    assert padded_rows_for(100, 24) % 24 == 0
+
+
+def test_mesh_plan_record_and_locals():
+    plan = MeshPlan(devices=4)
+    assert plan.n_devices() == 4
+    assert plan.n_local(45) == padded_rows_for(45, 4) // 4
+    rec = plan.as_record()
+    assert rec == {"devices": 4, "axis": "points",
+                   "pad_quantum": PAD_QUANTUM}
+    # None = all visible devices (the 8-wide test mesh)
+    assert MeshPlan().n_devices() == len(jax.devices())
+    # the optimizer accepts the plan object directly
+    r = ShardedOptimizer(TsneConfig(iterations=2), 45, mesh=plan)
+    assert r.n_devices == 4 and r.plan is plan
+
+
+# ---- the tier-1 mesh matrix: bit-for-bit at every segment boundary ---------
+
+@pytest.mark.parametrize("arm", ["health", "telemetry"])
+def test_mesh_matrix_bit_identical_at_segment_boundaries(arm):
+    st, jidx, jval = problem()
+    cfg = TsneConfig(iterations=30, repulsion="exact", row_chunk=8)
+    kw = {"health_check": arm == "health", "telemetry": arm == "telemetry"}
+    runs = {}
+    for d in (1, 4):
+        boundaries = {}
+        r = ShardedOptimizer(cfg, 45, n_devices=d)
+        state, losses = r(st, jidx, jval, checkpoint_every=10,
+                          checkpoint_cb=lambda s, it, ls: boundaries.update(
+                              {it: (np.asarray(s.y), np.asarray(ls))}),
+                          **kw)
+        runs[d] = (boundaries, np.asarray(state.y), np.asarray(losses),
+                   r.telemetry_)
+    b1, y1, l1, t1 = runs[1]
+    b4, y4, l4, t4 = runs[4]
+    assert set(b1) == set(b4) == {10, 20}
+    for it in b1:
+        np.testing.assert_array_equal(b4[it][0], b1[it][0],
+                                      err_msg=f"boundary {it}")
+        np.testing.assert_array_equal(b4[it][1], b1[it][1],
+                                      err_msg=f"boundary {it}")
+    np.testing.assert_array_equal(y4, y1)
+    np.testing.assert_array_equal(l4, l1)
+    if arm == "telemetry":
+        np.testing.assert_array_equal(t4, t1)
+
+
+def test_mesh_quality_config_bit_identical():
+    """The acceptance shape-class pin: the 10k-quality-style config (auto
+    repulsion resolves to exact at this N, default-ish row_chunk) on a
+    4-wide mesh reproduces the 1-device bits end to end."""
+    n = 1200  # same resolved plan class as the 10k quality config,
+    #           tier-1-affordable; row_chunk > n_local exercises the
+    #           chunk-shape invariance
+    st, jidx, jval = problem(n=n, k=12, perplexity=8.0)
+    cfg = TsneConfig(iterations=20, repulsion="exact", row_chunk=2048)
+    outs = {}
+    for d in (1, 4):
+        state, losses = ShardedOptimizer(cfg, n, n_devices=d)(st, jidx, jval)
+        outs[d] = (np.asarray(state.y), np.asarray(losses))
+    np.testing.assert_array_equal(outs[4][0], outs[1][0])
+    np.testing.assert_array_equal(outs[4][1], outs[1][1])
+
+
+# ---- supervisor paths on the unified pipeline ------------------------------
+
+def test_oom_ladder_on_meshed_pipeline(tmp_path):
+    """A device OOM during optimize on a 4-wide mesh degrades through the
+    SAME ladder the 1-device path uses (the supervisor/fleet admission
+    machinery runs unmodified against the unified pipeline) and the
+    demoted run completes."""
+    from tsne_flink_tpu.runtime.supervisor import (Supervisor,
+                                                   run_plan_from_fit,
+                                                   supervised_embed)
+    from tsne_flink_tpu.utils.artifacts import ArtifactCache
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(3, 6)) * 4.0
+    x = jnp.asarray(centers[rng.integers(0, 3, 60)]
+                    + rng.normal(size=(60, 6)))
+    cfg = TsneConfig(iterations=40, perplexity=5.0, repulsion="exact",
+                     row_chunk=8)
+    faults.activate("oom@optimize:seg1")
+    try:
+        sup = Supervisor(run_plan_from_fit(60, 6, 15, cfg, "auto",
+                                           "bruteforce", mesh=4),
+                         max_retries=2, on_oom="ladder")
+        y, losses = supervised_embed(
+            x, cfg, supervisor=sup, neighbors=15, seed=0, mesh_devices=4,
+            artifact_cache=ArtifactCache(str(tmp_path)))
+    finally:
+        faults.activate(None)
+    assert np.isfinite(np.asarray(y)).all()
+    assert any(e["type"] == "oom" for e in sup.events)
+    assert [d["action"] for d in sup.degradations] == ["repulsion-demote"]
+    assert sup.ladder.plan.mesh == 4  # the plan the ladder reasons over
+
+
+def test_divergence_rollback_on_meshed_pipeline():
+    """Seeded-NaN segment on a 4-wide mesh: the sentinel rolls back at the
+    boundary, halves eta, and converges — and the recovered trajectory is
+    bit-identical to the 1-device recovery (the rollback math is part of
+    the canonical program)."""
+    st, jidx, jval = problem()
+    outs = {}
+    for d in (1, 4):
+        faults.activate("nan@optimize:seg1")
+        try:
+            events = []
+            cfg = TsneConfig(iterations=30, repulsion="exact", row_chunk=8)
+            r = ShardedOptimizer(cfg, 45, n_devices=d)
+            state, losses = r(st, jidx, jval, checkpoint_every=10,
+                              checkpoint_cb=lambda *a: None,
+                              health_check=True, events=events)
+        finally:
+            faults.activate(None)
+        assert any(e.get("type") == "sentinel-rollback" or "eta" in e
+                   for e in events), events
+        outs[d] = (np.asarray(state.y), np.asarray(losses))
+    np.testing.assert_array_equal(outs[4][0], outs[1][0])
+    np.testing.assert_array_equal(outs[4][1], outs[1][1])
+
+
+def test_estimator_mesh_matches_trivial_mesh():
+    from tsne_flink_tpu import TSNE
+
+    rng = np.random.default_rng(1)
+    centers = rng.normal(size=(3, 8)) * 5.0
+    x = centers[rng.integers(0, 3, 52)] + rng.normal(size=(52, 8))
+    y1 = TSNE(perplexity=5.0, n_iter=40, random_state=4,
+              knn_method="bruteforce", repulsion="exact",
+              mesh=1).fit_transform(x)
+    y4 = TSNE(perplexity=5.0, n_iter=40, random_state=4,
+              knn_method="bruteforce", repulsion="exact",
+              mesh=4).fit_transform(x)
+    np.testing.assert_array_equal(y4, y1)
+
+
+# ---- checkpoint portability across mesh widths (CLI subprocesses) ----------
+
+def _blob_csv(tmp, n=40, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, d)) * 4.0
+    x = centers[rng.integers(0, 3, n)] + rng.normal(size=(n, d))
+    path = os.path.join(tmp, "in.csv")
+    with open(path, "w") as f:
+        for i in range(n):
+            for j in range(d):
+                f.write(f"{i},{j},{float(x[i, j])!r}\n")
+    return path
+
+
+def _cli(tmp, inp, out, extra, device_count=4):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TSNE_ARTIFACTS="0",
+               TSNE_AOT_CACHE="0", TSNE_TRACE="0",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count="
+                         f"{device_count}")
+    env.pop("TSNE_FAULT_PLAN", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "tsne_flink_tpu.utils.cli",
+         "--input", inp, "--output", out, "--dimension", "6",
+         "--knnMethod", "bruteforce", "--perplexity", "5",
+         "--dtype", "float64", "--noCache",
+         "--loss", os.path.join(tmp, "loss.txt")] + extra,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stderr[-3000:]
+    return r
+
+
+def test_fat_checkpoint_portable_across_mesh_widths(tmp_path):
+    """Satellite 2: a fat v2 checkpoint written on a 1-device mesh resumes
+    BIT-identically on a 4-virtual-device CPU mesh, and vice versa — the
+    resumed runs and an uninterrupted run all land the same final
+    checkpoint arrays."""
+    tmp = str(tmp_path)
+    inp = _blob_csv(tmp)
+
+    def final_state(name):
+        st, it, losses = ckpt.load(os.path.join(tmp, name))
+        return st, it, losses
+
+    # the uninterrupted 40-iteration reference, 1-wide mesh
+    _cli(tmp, inp, os.path.join(tmp, "full.csv"),
+         ["--iterations", "40", "--mesh", "1",
+          "--checkpoint", os.path.join(tmp, "full.npz")])
+    ref, it_ref, loss_ref = final_state("full.npz")
+    assert it_ref == 40
+
+    for src, dst in ((1, 4), (4, 1)):
+        # write the fat checkpoint at iteration 20 on the src mesh ...
+        _cli(tmp, inp, os.path.join(tmp, f"part{src}.csv"),
+             ["--iterations", "20", "--mesh", str(src), "--fatCheckpoint",
+              "--checkpoint", os.path.join(tmp, f"part{src}.npz")])
+        # ... and resume it to 40 on the dst mesh
+        _cli(tmp, inp, os.path.join(tmp, f"res{src}to{dst}.csv"),
+             ["--iterations", "40", "--mesh", str(dst),
+              "--resume", os.path.join(tmp, f"part{src}.npz"),
+              "--checkpoint", os.path.join(tmp, f"res{src}to{dst}.npz")])
+        got, it, losses = final_state(f"res{src}to{dst}.npz")
+        assert it == 40
+        np.testing.assert_array_equal(got.y, ref.y,
+                                      err_msg=f"mesh {src}->{dst}")
+        np.testing.assert_array_equal(got.update, ref.update)
+        np.testing.assert_array_equal(got.gains, ref.gains)
+        np.testing.assert_array_equal(losses, loss_ref)
+
+
+def test_spmd_flag_is_deprecated_alias(tmp_path):
+    """--spmd warns and runs the unified mesh pipeline; --affinityAssembly
+    now composes with it (the old guard is gone)."""
+    tmp = str(tmp_path)
+    inp = _blob_csv(tmp)
+    out = os.path.join(tmp, "out.csv")
+    r = _cli(tmp, inp, out, ["--iterations", "10", "--spmd",
+                             "--affinityAssembly", "sorted"],
+             device_count=4)
+    assert "deprecated" in r.stderr
+    rows = np.loadtxt(out, delimiter=",", ndmin=2)
+    assert rows.shape == (40, 3) and np.isfinite(rows).all()

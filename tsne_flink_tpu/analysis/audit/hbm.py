@@ -158,43 +158,54 @@ def _affinity_stage(plan: PlanConfig) -> dict:
 
 
 def _optimize_stage(plan: PlanConfig) -> dict:
-    """The compiled loop's resident set + its dominant per-iteration
-    transients."""
+    """The compiled loop's PER-DEVICE resident set + its dominant
+    per-iteration transients.  graftmesh: the optimize loop is
+    point-sharded over ``plan.mesh`` devices, so every row-sharded term
+    (working set, P rows/edges, attraction sweep, per-row repulsion
+    tiles) is accounted at ``n_local = ceil(n / mesh)`` rows — the
+    gathered ``[N, m]`` embedding, the full-N distance-tile columns, and
+    the replicated FFT grid stay at N on every device.  mesh=1 reproduces
+    the old single-chip model exactly."""
     n, k, m, isz = plan.n, plan.k, plan.n_components, plan.itemsize
+    mesh = max(1, int(plan.mesh))
+    nl = -(-n // mesh)                        # per-device local rows
     s = plan.sym_width_est()
     label = plan.resolved_assembly()
     rep = plan.resolved_repulsion()
-    terms: dict[str, float] = {"repulsion": rep, "assembly": label}
-    state = 2.0 * 3.0 * n * m * isz           # (y, update, gains), updated
-    y_full = float(n * m * isz)
+    # mesh rides the term map as a string: the report renderer treats
+    # non-strings as byte counts (GiB-rounded)
+    terms: dict[str, float] = {"repulsion": rep, "assembly": label,
+                               "mesh": str(mesh)}
+    state = 2.0 * 3.0 * nl * m * isz          # (y, update, gains), updated
+    y_full = float(n * m * isz)               # gathered embedding: full N
     terms["state"] = state + y_full
     if label == "blocks":
-        p_arrays = n * k * (4.0 + isz) + n * k * (8.0 + isz)
-        e_attr = n * k                        # reverse block edge count
+        p_arrays = nl * k * (4.0 + isz) + nl * k * (8.0 + isz)
+        e_attr = nl * k                       # per-shard reverse block edges
         attr = e_attr * (2.0 * m * isz + 4.0 * isz)
     else:
-        p_arrays = float(n * s * (4 + isz))
+        p_arrays = float(nl * s * (4 + isz))
         # layout decision mirrors plan_edges' gate with the ~2Nk true-edge
         # upper bound: hub-widened rows route to the flat edge layout
         e_est = 2.0 * n * k
         from tsne_flink_tpu.ops.affinities import edges_beneficial
         if plan.attraction == "edges" or (
                 plan.attraction == "auto" and edges_beneficial(e_est, n, s)):
-            attr = e_est * (3.0 * 4.0 + 2.0 * m * isz + 2.0 * isz)
+            attr = (e_est / mesh) * (3.0 * 4.0 + 2.0 * m * isz + 2.0 * isz)
         else:
-            c = min(plan.row_chunk, n)
+            c = min(plan.row_chunk, nl)
             attr = PIPELINE_FACTOR * c * s * (m * isz + isz + 4.0)
     terms["p_arrays"] = p_arrays
     terms["attraction"] = attr
     if rep == "exact":
-        c = min(plan.row_chunk, n)
+        c = min(plan.row_chunk, nl)
         terms["repulsion_tile"] = PIPELINE_FACTOR * c * n * isz
     elif rep == "bh":
         from tsne_flink_tpu.ops.repulsion_bh import (default_frontier,
                                                      default_levels)
         lv = default_levels(n, m)
         fr = default_frontier(n, m, lv, plan.theta)
-        c = min(plan.row_chunk, n)
+        c = min(plan.row_chunk, nl)
         terms["repulsion_tile"] = c * fr * 3.0 * isz + n * lv * 4.0
     else:  # fft
         from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
@@ -217,6 +228,9 @@ def plan_hbm_report(plan: PlanConfig) -> dict:
         "stages": {st: {t: (v if isinstance(v, str) else _gib(v))
                         for t, v in terms.items()}
                    for st, terms in stages.items()},
+        # graftmesh: the estimate is PER DEVICE on a `mesh`-wide point
+        # mesh (optimize terms row-scaled; prepare host-staged at full N)
+        "mesh": max(1, int(plan.mesh)),
         "peak_hbm_est": int(peak),
         "peak_hbm_est_gib": _gib(peak),
         "peak_stage": peak_stage,

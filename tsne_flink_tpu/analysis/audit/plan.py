@@ -67,6 +67,13 @@ class PlanConfig:
     sym_width: int | None = None     # measured hub width when known
     row_chunk: int = 2048            # optimizer tile rows (TsneConfig)
     knn_padding: str = "index-space"
+    #: graftmesh: width of the 1-D point mesh the optimize loop runs on
+    #: (1 = the trivial mesh — the former single-chip path).  The HBM
+    #: model scales the row-sharded optimize terms per device with it, so
+    #: the auditor picks the cheapest feasible plan PER MESH instead of
+    #: per device; prepare stays host-staged (single-device) in the
+    #: unified pipeline and is not scaled.
+    mesh: int = 1
     name: str = "plan"
 
     def __post_init__(self):
@@ -75,6 +82,8 @@ class PlanConfig:
                              f"({' | '.join(KNN_PADDING_MODES)})")
         if self.assembly not in ("auto", "sorted", "split", "blocks"):
             raise ValueError(f"assembly '{self.assembly}' not defined")
+        if int(self.mesh) < 1:
+            raise ValueError(f"mesh width {self.mesh} must be >= 1")
 
     # ---- resolved plan quantities (the pipeline's own policies) ----
 

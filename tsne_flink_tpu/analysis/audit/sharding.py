@@ -110,10 +110,10 @@ def audit_sharding() -> tuple[list[Finding], dict]:
     import jax.numpy as jnp
 
     from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
-    from tsne_flink_tpu.parallel.mesh import AXIS, make_mesh
+    from tsne_flink_tpu.parallel.mesh import (AXIS, make_mesh, pspec, rspec,
+                                              state_pspec)
     from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
     from tsne_flink_tpu.utils.compat import shard_map
-    from jax.sharding import PartitionSpec as P
 
     findings = _signature_findings()
     report: dict = {"signature_defaults_ok": not findings}
@@ -145,12 +145,11 @@ def audit_sharding() -> tuple[list[Finding], dict]:
         state = TsneState(y=jax.ShapeDtypeStruct((n, 2), jnp.float32),
                           update=jax.ShapeDtypeStruct((n, 2), jnp.float32),
                           gains=jax.ShapeDtypeStruct((n, 2), jnp.float32))
-        pspec = P(AXIS)
-        sspec = TsneState(y=pspec, update=pspec, gains=pspec)
+        sspec = state_pspec()
         fn = shard_map(
             lambda st, ji, jv: optimize(st, ji, jv, cfg, axis_name=AXIS),
-            mesh=mesh, in_specs=(sspec, pspec, pspec),
-            out_specs=(sspec, P()))
+            mesh=mesh, in_specs=(sspec, pspec(), pspec()),
+            out_specs=(sspec, rspec()))
         return jax.make_jaxpr(fn)(
             state, jax.ShapeDtypeStruct((n, 2 * k), jnp.int32),
             jax.ShapeDtypeStruct((n, 2 * k), jnp.float32))
@@ -164,8 +163,8 @@ def audit_sharding() -> tuple[list[Finding], dict]:
         fn = shard_map(
             lambda i, p: symmetrize_alltoall(i, p, dcount, 2 * k,
                                              axis_name=AXIS),
-            mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-            out_specs=(P(AXIS), P(AXIS), P(), P(), P()))
+            mesh=mesh, in_specs=(pspec(), pspec()),
+            out_specs=(pspec(), pspec(), rspec(), rspec(), rspec()))
         return jax.make_jaxpr(fn)(
             jax.ShapeDtypeStruct((n, k), jnp.int32),
             jax.ShapeDtypeStruct((n, k), jnp.float32))

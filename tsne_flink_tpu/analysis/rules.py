@@ -36,6 +36,14 @@ once (ADVICE/VERDICT rounds 1-5); the linter catches it forever:
   so every measured second lands in the trace/metrics schema instead of
   a private variable — the pre-obsgraft world where bench.py was the
   only timed entry point.
+* ``mesh-hygiene``      — parallelism primitives outside
+  ``parallel/mesh.py``: raw axis-name string literals (the mesh axis
+  name as a bare ``"points"`` constant), ``pmap`` calls, or
+  ``PartitionSpec`` construction/import anywhere else in the package.
+  graftmesh made ``parallel/mesh.py`` the ONE place mesh axes and specs
+  are made (``AXIS``, ``pspec``/``rspec``/``state_pspec``, ``MeshPlan``)
+  — a drifted literal or a second spec factory is how the two-pipeline
+  seam grew the first time.
 
 Rules are pure-AST project passes registered with :func:`core.rule`; they
 never import the code under analysis.
@@ -973,6 +981,100 @@ def resource_hygiene(project: Project):
             check(_resource_acquisitions(ast.walk(n), tempfile_names,
                                          from_tmp_names, fcntl_names),
                   has_finally, "module scope")
+    return findings
+
+
+# ---- rule: mesh-hygiene ----------------------------------------------------
+
+MESH_MODULE_SUFFIX = "parallel/mesh.py"
+
+
+def _mesh_axis_name(project: Project) -> str | None:
+    """The mesh axis name, parsed from the scanned ``parallel/mesh.py``
+    (``AXIS = "..."``) — or, for fixture runs, from the file shipped next
+    to this package (mirrors :func:`_declared_env_vars`; the linter never
+    imports the code under analysis)."""
+    mod = project.module_with_suffix(MESH_MODULE_SUFFIX)
+    tree = mod.tree if mod is not None else None
+    if tree is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "parallel", "mesh.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except OSError:
+            return None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "AXIS"
+                        for t in node.targets)):
+            val = _literal(node.value)
+            if isinstance(val, str):
+                return val
+    return None
+
+
+def _docstring_constants(tree: ast.AST) -> set[int]:
+    """ids of every docstring Constant node (module/class/def leading
+    string statements) — prose mentioning the axis name is not a finding."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        body = getattr(node, "body", [])
+        if (body and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            out.add(id(body[0].value))
+    return out
+
+
+@rule("mesh-hygiene",
+      "raw axis-name literals, pmap, or PartitionSpec construction outside "
+      "parallel/mesh.py — mesh axes and specs are made in ONE place")
+def mesh_hygiene(project: Project):
+    findings = []
+    axis = _mesh_axis_name(project)
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        if not ("tsne_flink_tpu/" in norm
+                or norm.startswith("tsne_flink_tpu")):
+            continue  # package scope: scripts/tests compose freely
+        if norm.endswith(MESH_MODULE_SUFFIX):
+            continue  # the one legitimate home
+        ps_names = _from_import_aliases(mod.tree, "PartitionSpec")
+        pmap_names = _from_import_aliases(mod.tree, "pmap")
+        docstrings = _docstring_constants(mod.tree)
+        for node in ast.walk(mod.tree):
+            # (a) raw axis-name literal (prose/docstrings excluded)
+            if (axis is not None and isinstance(node, ast.Constant)
+                    and node.value == axis and id(node) not in docstrings):
+                findings.append(mod.finding(
+                    "mesh-hygiene", node,
+                    f"raw axis-name literal '{axis}': import AXIS from "
+                    "tsne_flink_tpu.parallel.mesh — a drifted literal "
+                    "binds collectives to a dead axis"))
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # (b) pmap: graftmesh programs are shard_map-only
+            if ((isinstance(func, ast.Attribute) and func.attr == "pmap")
+                    or _is_name_in(func, pmap_names)):
+                findings.append(mod.finding(
+                    "mesh-hygiene", node,
+                    "pmap call: graftmesh parallelism is shard_map + "
+                    "named-axis specs only (parallel/mesh.py); pmap "
+                    "programs cannot share the unified pipeline's specs"))
+            # (c) PartitionSpec construction outside the spec factory
+            if ((isinstance(func, ast.Attribute)
+                 and func.attr == "PartitionSpec")
+                    or _is_name_in(func, ps_names)):
+                findings.append(mod.finding(
+                    "mesh-hygiene", node,
+                    "PartitionSpec constructed outside parallel/mesh.py: "
+                    "use pspec()/rspec()/state_pspec() so the spec layout "
+                    "stays a single definition"))
     return findings
 
 

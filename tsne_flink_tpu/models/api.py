@@ -35,6 +35,7 @@ class TSNE:
                  knn_refine: int | None = None, knn_autotune: bool = False,
                  random_state: int = 0,
                  spmd: bool = False, devices: int | None = None,
+                 mesh: int | None = None,
                  sym_mode: str = "replicated", attraction: str = "auto",
                  sym_width: int | None = None, sym_slack: int | None = None,
                  sym_strict: bool = False, bh_gate: str = "vdm",
@@ -70,9 +71,21 @@ class TSNE:
         # the measured winner; steers only recall-invariant tile shapes
         self.knn_autotune = knn_autotune
         self.random_state = random_state
-        # spmd=True runs the whole job as ONE sharded program over a
-        # `devices`-wide point mesh (the CLI's --spmd / SpmdPipeline) —
-        # required once N outgrows one chip
+        # graftmesh: `mesh=N` runs the fit's optimize loop on an N-wide
+        # point mesh through the ONE unified pipeline (the CLI's --mesh;
+        # 1 device = the trivial mesh, and widths sharing the padding
+        # quantum are bit-identical).  None keeps the single-device
+        # default.  `spmd=True` is the DEPRECATED alias: it now routes
+        # single-process fits through the same unified path over
+        # `devices` (or all) devices; only multi-controller processes
+        # still use the SpmdPipeline compatibility wrapper.
+        self.mesh = mesh
+        if spmd:
+            import warnings
+            warnings.warn(
+                "TSNE(spmd=True) is deprecated — the pipeline is "
+                "mesh-parametric (graftmesh); use TSNE(mesh=N) instead",
+                DeprecationWarning, stacklevel=2)
         self.spmd = spmd
         self.devices = devices
         self.sym_mode = sym_mode
@@ -103,13 +116,9 @@ class TSNE:
                                      "blocks"):
             raise ValueError(f"affinity_assembly '{affinity_assembly}' not "
                              "defined (auto | sorted | split | blocks)")
-        if affinity_assembly is not None and spmd:
-            # NOT silently ignored: the spmd pipeline symmetrizes with its
-            # own replicated/alltoall strategies, so any assembly override
-            # would be dropped on the floor — refuse instead
-            raise ValueError(f"affinity_assembly='{affinity_assembly}' has "
-                             "no effect with spmd=True (symmetrization is "
-                             "chosen by sym_mode there); leave it None")
+        # graftmesh deleted the old affinity_assembly-with-spmd refusal:
+        # every single-process fit — spmd alias included — runs the
+        # host-staged prepare, where assembly overrides genuinely apply
         self.affinity_assembly = affinity_assembly
         # compute dtype for the whole pipeline (the CLI's --dtype): None
         # keeps the input's dtype; "bfloat16" is the MXU-native 2x path
@@ -248,7 +257,7 @@ class TSNE:
         import jax
 
         cfg = self._config(x.shape[0])
-        if self.spmd:
+        if self.spmd and jax.process_count() > 1:
             from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
 
             n, d = x.shape
@@ -300,6 +309,16 @@ class TSNE:
                 Supervisor, is_oom, run_plan_from_fit, supervised_embed)
             k = (self.neighbors if self.neighbors is not None
                  else 3 * int(cfg.perplexity))
+            # graftmesh: the mesh width this fit's optimize loop runs on.
+            # mesh=N is explicit; the deprecated spmd=True aliases to
+            # `devices` (or all); default stays the trivial 1-wide mesh.
+            if self.mesh is not None:
+                mesh_devices = int(self.mesh)
+            elif self.spmd:
+                mesh_devices = (int(self.devices) if self.devices is not None
+                                else jax.device_count())
+            else:
+                mesh_devices = 1
             sup = Supervisor(
                 run_plan_from_fit(x.shape[0], x.shape[1], k, cfg,
                                   self.affinity_assembly or "auto",
@@ -307,6 +326,7 @@ class TSNE:
                                   knn_rounds=self.knn_iterations,
                                   knn_refine=self.knn_refine,
                                   sym_width=self.sym_width,
+                                  mesh=mesh_devices,
                                   name="estimator-fit"),
                 max_retries=self.max_retries, on_oom=self.on_oom,
                 health_check=self.health_check)
@@ -321,12 +341,18 @@ class TSNE:
                 affinity_assembly=self.affinity_assembly,
                 artifact_cache=self._artifact_cache())
             if (self.health_check or self.telemetry
+                    or self.mesh is not None or self.spmd
                     or faults.injector() is not None):
                 # supervised segmented path: the sentinel (and fault
-                # injection, and the telemetry boundary reads) need
-                # segment boundaries
+                # injection, the telemetry boundary reads, and any
+                # EXPLICIT mesh request — mesh=1 included: the trivial
+                # mesh runs the canonical program, so mesh=1 == mesh=4
+                # bit for bit) run through the unified segmented
+                # optimizer; a defaulted fit keeps the byte-identical
+                # fast path
                 y, losses = supervised_embed(x, cfg, supervisor=sup,
                                              telemetry=self.telemetry,
+                                             mesh_devices=mesh_devices,
                                              **embed_kwargs)
                 self._last_telemetry = sup.last_telemetry
             else:

@@ -124,16 +124,31 @@ def _pmin(x, axis_name):
     return x if axis_name is None else lax.pmin(x, axis_name)
 
 
+def _mesh_sum(per_row, axis_name):
+    """Mesh-canonical global sum of a per-row partial (graftmesh): gather
+    the ``[N_padded]`` row vector — identical content and shape on every
+    mesh width that shares the padding quantum (``parallel/mesh.PAD_QUANTUM``)
+    — and reduce it in ONE fixed order.  This is the reduction the
+    bit-identity contract (mesh D == mesh 1, pinned by tests/test_mesh.py)
+    rides on: a per-shard ``psum`` would regroup the row sums per mesh
+    width.  Collective cost: one ``[N]`` all_gather per call — noise next
+    to the per-iteration ``[N, m]`` embedding gather the gradient already
+    pays."""
+    return jnp.sum(lax.all_gather(per_row, axis_name, tiled=True))
+
+
 def _telemetry_row(st: "TsneState", grad, axis_name, valid):
     """One :data:`TELEMETRY_FIELDS` row from the post-update state: global
     grad L2 norm, gains mean/max, embedding bbox — every value is a global
-    scalar (psum/pmin/pmax over the mesh), so the row is replication-
-    invariant like the loss trace.  ``grad`` is already masked to valid
-    rows; padded gains/y rows are masked here."""
+    scalar, so the row is replication-invariant like the loss trace.
+    ``grad`` is already masked to valid rows; padded gains/y rows are
+    masked here.  Under a mesh the floating sums are mesh-canonical
+    (:func:`_mesh_sum`) so the telemetry trace is bit-identical across
+    mesh widths; min/max are exact under any reduction order and keep
+    pmin/pmax, and the count is a sum of exact integers."""
     dt = st.y.dtype
-    gn2 = _psum(jnp.sum(grad * grad), axis_name)
     if valid is None:
-        gsum = _psum(jnp.sum(st.gains), axis_name)
+        vm = w = None
         gcnt = _psum(jnp.asarray(st.gains.size, dt), axis_name)
         gmax = _pmax(jnp.max(st.gains), axis_name)
         ymin = _pmin(jnp.min(st.y), axis_name)
@@ -141,17 +156,23 @@ def _telemetry_row(st: "TsneState", grad, axis_name, valid):
     else:
         vm = valid[:, None]
         w = valid.astype(dt)
-        gsum = _psum(jnp.sum(st.gains * w[:, None]), axis_name)
         gcnt = _psum(jnp.sum(w), axis_name) * st.gains.shape[1]
         gmax = _pmax(jnp.max(jnp.where(vm, st.gains, -jnp.inf)), axis_name)
         ymin = _pmin(jnp.min(jnp.where(vm, st.y, jnp.inf)), axis_name)
         ymax = _pmax(jnp.max(jnp.where(vm, st.y, -jnp.inf)), axis_name)
+    gains_m = st.gains if w is None else st.gains * w[:, None]
+    if axis_name is None:
+        gn2 = jnp.sum(grad * grad)
+        gsum = jnp.sum(gains_m)
+    else:
+        gn2 = _mesh_sum(jnp.sum(grad * grad, axis=1), axis_name)
+        gsum = _mesh_sum(jnp.sum(gains_m, axis=1), axis_name)
     return jnp.stack([jnp.sqrt(gn2), gsum / gcnt, gmax, ymin,
                       ymax]).astype(dt)
 
 
 def _attractive_forces(y_local, y_full, jidx, jval, exag, z,
-                       row_chunk=4096):
+                       row_chunk=4096, row_loss=False):
     """F_attr_i = Σ_j P_ij q_ij (y_i − y_j) with the Student-t kernel
     q = 1/(1 + ‖y_i − y_j‖²) (TsneHelpers.scala:284-305), plus the partial
     KL loss Σ p log(p/(q/Z)) (:297-300).  Row-chunked so the [c, S, m]
@@ -186,16 +207,23 @@ def _attractive_forces(y_local, y_full, jidx, jval, exag, z,
         mask = vc > 0
         pe_safe = jnp.where(mask, pe, 1.0)
         q_safe = jnp.where(mask, q, 1.0)
-        loss = jnp.sum(jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0))
-        return att, loss
+        terms = jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0)
+        # row_loss (static): per-row partial KL — the mesh-canonical form
+        # the sharded optimizer reduces via _mesh_sum (graftmesh); False
+        # keeps the scalar path byte-identical to the pre-graftmesh code
+        return att, (jnp.sum(terms, axis=1) if row_loss
+                     else jnp.sum(terms))
 
     att, loss = lax.map(one_chunk, (yp.reshape(nchunks, c, m),
                                     ip.reshape(nchunks, c, s),
                                     vp.reshape(nchunks, c, s)))
+    if row_loss:
+        return att.reshape(-1, m)[:nloc], loss.reshape(-1)[:nloc]
     return att.reshape(-1, m)[:nloc], jnp.sum(loss)
 
 
-def _attractive_forces_edges(y_local, y_full, src, dst, val, exag, z):
+def _attractive_forces_edges(y_local, y_full, src, dst, val, exag, z,
+                             row_loss=False):
     """Edge-layout attraction: identical math to :func:`_attractive_forces`
     (including the always-sqeuclidean Student-t kernel — see its docstring)
     but summed per-edge with a sorted ``segment_sum`` instead of per padded
@@ -215,7 +243,16 @@ def _attractive_forces_edges(y_local, y_full, src, dst, val, exag, z):
     mask = val > 0
     pe_safe = jnp.where(mask, pe, 1.0)
     q_safe = jnp.where(mask, q, 1.0)
-    loss = jnp.sum(jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0))
+    terms = jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0)
+    if row_loss:
+        # per-row partial KL via the same sorted segment reduction as the
+        # forces — mesh-canonical (the zero padding edges land on the last
+        # local row and add exactly 0.0)
+        loss = jax.ops.segment_sum(terms, src,
+                                   num_segments=y_local.shape[0],
+                                   indices_are_sorted=True)
+    else:
+        loss = jnp.sum(terms)
     return att, loss
 
 
@@ -225,7 +262,15 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
     """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317).
 
     ``valid_full`` is the GLOBAL point-validity mask (already gathered once,
-    outside the iteration loop — it is loop-invariant)."""
+    outside the iteration loop — it is loop-invariant).
+
+    Under a mesh (``axis_name`` given) the Z and KL reductions are
+    mesh-canonical (graftmesh): the kernels return PER-ROW partials
+    (``row_z``/``row_loss``) and :func:`_mesh_sum` reduces the gathered
+    ``[N_padded]`` vector in one fixed order, so every mesh width sharing
+    the padding quantum reproduces the same bits.  ``axis_name=None``
+    keeps the original scalar reductions byte-for-byte."""
+    row_r = axis_name is not None
     y_full = (y_local if axis_name is None
               else lax.all_gather(y_local, axis_name, tiled=True))
     if cfg.repulsion == "exact":
@@ -242,39 +287,43 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
         if impl == "pallas":
             rep, sq = pallas_exact_repulsion(y_local, y_full,
                                              row_offset=row_offset,
-                                             col_valid=valid_full)
+                                             col_valid=valid_full,
+                                             row_z=row_r)
         else:
             rep, sq = exact_repulsion(y_local, y_full, row_offset=row_offset,
                                       col_valid=valid_full,
-                                      row_chunk=cfg.row_chunk)
+                                      row_chunk=cfg.row_chunk, row_z=row_r)
     elif cfg.repulsion == "bh":
         rep, sq = bh_repulsion(y_local, y_full, theta=cfg.theta,
                                levels=cfg.bh_levels, frontier=cfg.bh_frontier,
                                gate=cfg.bh_gate, row_offset=row_offset,
-                               col_valid=valid_full, row_chunk=cfg.row_chunk)
+                               col_valid=valid_full, row_chunk=cfg.row_chunk,
+                               row_z=row_r)
     elif cfg.repulsion == "fft":
         rep, sq = fft_repulsion(y_local, y_full, grid=cfg.fft_grid,
                                 interp=cfg.fft_interp, row_offset=row_offset,
-                                col_valid=valid_full)
+                                col_valid=valid_full, row_z=row_r)
     else:
         raise ValueError(f"unknown repulsion backend '{cfg.repulsion}'")
-    z = _psum(sq, axis_name)
+    z = _mesh_sum(sq, axis_name) if row_r else _psum(sq, axis_name)
     if edges is not None and edges_extra:
         # split-blocks layout (affinities.symmetrize_split_blocks): the
         # rows part is the width-k forward block with merged values, the
         # edges part the reverse-only entries — attraction is their sum
         att, loss = _attractive_forces(y_local, y_full, jidx, jval,
-                                       exag, z, row_chunk=cfg.row_chunk)
+                                       exag, z, row_chunk=cfg.row_chunk,
+                                       row_loss=row_r)
         att_r, loss_r = _attractive_forces_edges(y_local, y_full, *edges,
-                                                 exag, z)
+                                                 exag, z, row_loss=row_r)
         att, loss = att + att_r, loss + loss_r
     elif edges is not None:
         att, loss = _attractive_forces_edges(y_local, y_full, *edges,
-                                             exag, z)
+                                             exag, z, row_loss=row_r)
     else:
         att, loss = _attractive_forces(y_local, y_full, jidx, jval,
-                                       exag, z, row_chunk=cfg.row_chunk)
-    loss = _psum(loss, axis_name)
+                                       exag, z, row_chunk=cfg.row_chunk,
+                                       row_loss=row_r)
+    loss = _mesh_sum(loss, axis_name) if row_r else _psum(loss, axis_name)
     return att - rep / z, loss
 
 
@@ -288,13 +337,21 @@ def _update_embedding(state: TsneState, grad, momentum, cfg: TsneConfig):
 
 
 def _global_mean(x, axis_name=None, valid=None):
-    """Mean over the (global, psum'd) point axis, ignoring padded rows."""
-    if valid is None:
-        total = _psum(jnp.sum(x, axis=0), axis_name)
+    """Mean over the (global) point axis, ignoring padded rows.  Under a
+    mesh the total is mesh-canonical (gather the masked ``[N_padded, m]``
+    rows, reduce the same array on every width — graftmesh bit-identity);
+    the count is a sum of exact integers, so its psum is exact under any
+    reduction order.  ``axis_name=None`` is byte-identical to the
+    pre-graftmesh reduction."""
+    w = None if valid is None else valid.astype(x.dtype)
+    xm = x if w is None else x * w[:, None]
+    if axis_name is None:
+        total = jnp.sum(xm, axis=0)
+    else:
+        total = jnp.sum(lax.all_gather(xm, axis_name, tiled=True), axis=0)
+    if w is None:
         count = _psum(jnp.asarray(x.shape[0], x.dtype), axis_name)
     else:
-        w = valid.astype(x.dtype)
-        total = _psum(jnp.sum(x * w[:, None], axis=0), axis_name)
         count = _psum(jnp.sum(w), axis_name)
     return total / count
 

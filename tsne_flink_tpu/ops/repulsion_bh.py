@@ -172,9 +172,11 @@ def bh_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
                  theta: float = 0.25, levels: int | None = None,
                  frontier: int | None = None, gate: str = "vdm",
                  row_offset: int = 0,
-                 col_valid: jnp.ndarray | None = None, row_chunk: int = 8192):
+                 col_valid: jnp.ndarray | None = None, row_chunk: int = 8192,
+                 row_z: bool = False):
     """Theta-gated repulsive forces; same contract as ``exact_repulsion``:
-    returns (rep [len(y), m] unnormalized, partial Z).  ``frontier=None``
+    returns (rep [len(y), m] unnormalized, partial Z — per-row with
+    ``row_z=True``, the mesh-canonical form).  ``frontier=None``
     resolves through :func:`default_frontier` (depth/theta-scaled)."""
     if gate not in ("vdm", "flink"):
         raise ValueError(f"unknown bh gate '{gate}'")
@@ -276,9 +278,11 @@ def bh_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
         yc, lc, okc = args
         rep, sq = jax.vmap(point_rep)(yc, lc)
         rep = rep * okc[:, None]
-        return rep, jnp.sum(sq * okc)
+        return rep, (sq * okc if row_z else jnp.sum(sq * okc))
 
     rep, sq = lax.map(one_chunk, (yp.reshape(nchunks, c, m),
                                   lp.reshape(nchunks, c),
                                   okp.reshape(nchunks, c)))
+    if row_z:
+        return rep.reshape(-1, m)[:nloc], sq.reshape(-1)[:nloc]
     return rep.reshape(-1, m)[:nloc], jnp.sum(sq)

@@ -28,7 +28,7 @@ from jax import lax
 
 def exact_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None,
                     *, row_offset: int = 0, col_valid: jnp.ndarray | None = None,
-                    row_chunk: int = 2048):
+                    row_chunk: int = 2048, row_z: bool = False):
     """Exact repulsive forces for rows ``y`` against the full embedding.
 
     ``y`` may be a shard of ``y_full`` (rows [row_offset, row_offset+len(y));
@@ -36,7 +36,11 @@ def exact_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None,
     points out of both Z and the forces.
 
     Returns ``(rep [len(y), m], sum_q scalar)`` — sum_q is this shard's partial
-    Z (psum over the mesh for the global Z).
+    Z (psum over the mesh for the global Z).  ``row_z=True`` (static) instead
+    returns the PER-ROW partial Z ``[len(y)]`` — the mesh-canonical form the
+    sharded optimizer gathers and reduces in one fixed order so a D-device
+    mesh reproduces the 1-device bits (graftmesh); with the default False the
+    scalar path is byte-identical to the pre-graftmesh kernel.
     """
     if y_full is None:
         y_full = y
@@ -66,7 +70,9 @@ def exact_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None,
         q2 = q * q
         # sum_j q² (y_i - y_j)  =  y_i · (Σ_j q²)  −  q² @ Y
         rep = yc * jnp.sum(q2, axis=1)[:, None] - q2 @ y_full
-        return rep, jnp.sum(q)
+        return rep, (jnp.sum(q, axis=1) if row_z else jnp.sum(q))
 
     rep, sq = lax.map(one_chunk, (yp.reshape(nchunks, c, m), starts))
+    if row_z:
+        return rep.reshape(-1, m)[:nloc], sq.reshape(-1)[:nloc]
     return rep.reshape(-1, m)[:nloc], jnp.sum(sq)

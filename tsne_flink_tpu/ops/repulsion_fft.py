@@ -65,8 +65,9 @@ def _lagrange_weights(t: jnp.ndarray, p: int) -> jnp.ndarray:
 def fft_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
                   grid: int | None = None, interp: int = 3,
                   row_offset: int = 0, col_valid: jnp.ndarray | None = None,
-                  **_unused):
-    """Same contract as exact_repulsion: (rep [len(y), m], partial-Z scalar).
+                  row_z: bool = False, **_unused):
+    """Same contract as exact_repulsion: (rep [len(y), m], partial-Z scalar
+    — or the per-row partial with ``row_z=True``, the mesh-canonical form).
 
     NOTE on sharding: like the BH tree build, the grid is built from the
     all-gathered ``y_full`` on every device (the grid is small; rebuilding
@@ -167,5 +168,7 @@ def fft_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
 
     rep = (y[:, :] * phi_f[:, :1] - phi_f[:, 1:]) * y_loc_w[:, None]
     # local partial Z: each local point's K1 potential minus its self-term
+    if row_z:
+        return rep, (phi_z - 1.0) * y_loc_w
     sum_q = jnp.sum((phi_z - 1.0) * y_loc_w)
     return rep, sum_q

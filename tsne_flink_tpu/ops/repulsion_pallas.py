@@ -31,7 +31,7 @@ TILE = 512    # row/col tile edge
 
 
 def _kernel(rows_ref, cols_ref, wr_ref, wc_ref, off_ref,
-            rep_ref, sumq_ref):
+            rep_ref, sumq_ref, *, row_z=False):
     j = pl.program_id(1)
 
     yr = rows_ref[:]                                  # [TR, 8]
@@ -67,14 +67,23 @@ def _kernel(rows_ref, cols_ref, wr_ref, wc_ref, off_ref,
 
     rep_ref[:] += partial
 
-    @pl.when((pl.program_id(0) == 0) & (j == 0))
-    def _():
-        # a concrete f32 zero, not the python literal: under x64 (the CPU
-        # interpret-mode test suite) a weak 0.0 is f64 and the legacy state
-        # discharge refuses the f64 -> f32 ref store
-        sumq_ref[0, 0] = jnp.zeros((), sumq_ref.dtype)
+    if row_z:
+        # mesh-canonical per-row partial Z (graftmesh): a [TR, 1] block
+        # revisited across column tiles, accumulated like the force block
+        @pl.when(j == 0)
+        def _():
+            sumq_ref[:] = jnp.zeros_like(sumq_ref)
 
-    sumq_ref[0, 0] += jnp.sum(q)
+        sumq_ref[:] += jnp.sum(q, axis=1, keepdims=True)
+    else:
+        @pl.when((pl.program_id(0) == 0) & (j == 0))
+        def _():
+            # a concrete f32 zero, not the python literal: under x64 (the CPU
+            # interpret-mode test suite) a weak 0.0 is f64 and the legacy
+            # state discharge refuses the f64 -> f32 ref store
+            sumq_ref[0, 0] = jnp.zeros((), sumq_ref.dtype)
+
+        sumq_ref[0, 0] += jnp.sum(q)
 
 
 def _pad_rows(a, to, fill=0.0):
@@ -85,9 +94,9 @@ def _pad_rows(a, to, fill=0.0):
                    constant_values=fill)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "tile"))
+@functools.partial(jax.jit, static_argnames=("interpret", "tile", "row_z"))
 def _run(y_loc, y_full, row_offset, w_loc, w_full, *,
-         interpret=False, tile=TILE):
+         interpret=False, tile=TILE, row_z=False):
     nloc, m = y_loc.shape
     nfull = y_full.shape[0]
     f32 = jnp.float32
@@ -99,9 +108,17 @@ def _run(y_loc, y_full, row_offset, w_loc, w_full, *,
     nr, nc = rows.shape[0] // tile, cols.shape[0] // tile
     off = jnp.asarray([[row_offset]], jnp.int32)  # (1, 1): SMEM scalars are 2-D
 
+    if row_z:
+        sumq_spec = pl.BlockSpec((tile, 1), lambda i, j: (i, 0),
+                                 memory_space=pltpu.VMEM)
+        sumq_shape = jax.ShapeDtypeStruct((nr * tile, 1), f32)
+    else:
+        sumq_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+        sumq_shape = jax.ShapeDtypeStruct((1, 1), f32)
+
     grid = (nr, nc)
     rep, sumq = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, row_z=row_z),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile, MPAD), lambda i, j: (i, 0),
@@ -117,11 +134,11 @@ def _run(y_loc, y_full, row_offset, w_loc, w_full, *,
         out_specs=[
             pl.BlockSpec((tile, MPAD), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+            sumq_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nr * tile, MPAD), f32),
-            jax.ShapeDtypeStruct((1, 1), f32),
+            sumq_shape,
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * (nr * tile) * (nc * tile) * MPAD,
@@ -130,7 +147,10 @@ def _run(y_loc, y_full, row_offset, w_loc, w_full, *,
         ),
         interpret=interpret,
     )(rows, cols, wr, wc, off)
-    return rep[:nloc, :m].astype(y_loc.dtype), sumq[0, 0].astype(y_loc.dtype)
+    rep_out = rep[:nloc, :m].astype(y_loc.dtype)
+    if row_z:
+        return rep_out, sumq[:nloc, 0].astype(y_loc.dtype)
+    return rep_out, sumq[0, 0].astype(y_loc.dtype)
 
 
 _MOSAIC_OK: bool | None = None
@@ -172,8 +192,9 @@ def mosaic_supported() -> bool:
 
 def pallas_exact_repulsion(y, y_full=None, *, row_offset=0,
                            col_valid=None, interpret=None, tile=TILE,
-                           **_unused):
-    """Drop-in for :func:`exact_repulsion`: (rep [len(y), m], partial-Z)."""
+                           row_z=False, **_unused):
+    """Drop-in for :func:`exact_repulsion`: (rep [len(y), m], partial-Z —
+    per-row with ``row_z=True``, the mesh-canonical form)."""
     if y_full is None:
         y_full = y
     nloc = y.shape[0]
@@ -184,4 +205,4 @@ def pallas_exact_repulsion(y, y_full=None, *, row_offset=0,
               else col_valid.astype(y.dtype))
     w_loc = jax.lax.dynamic_slice_in_dim(w_full, row_offset, nloc)
     return _run(y, y_full, jnp.asarray(row_offset, jnp.int32), w_loc, w_full,
-                interpret=interpret, tile=tile)
+                interpret=interpret, tile=tile, row_z=row_z)

@@ -34,6 +34,7 @@ from jax import lax
 from tsne_flink_tpu.ops.knn import _clamp_k, _topk_smallest, merge_rounds
 from tsne_flink_tpu.ops.metrics import pairwise
 from tsne_flink_tpu.ops.zorder import BITS_FOR_DIMS, morton_keys
+from tsne_flink_tpu.parallel.mesh import AXIS
 
 
 def _fold_tile(best, x_rows, x_cols, row_ids, col_ids, n_global, k, metric,
@@ -66,7 +67,7 @@ def _fold_tile(best, x_rows, x_cols, row_ids, col_ids, n_global, k, metric,
 
 
 def ring_knn(x_local: jnp.ndarray, k: int, n_shards: int, n_global: int,
-             metric: str = "sqeuclidean", *, axis_name: str = "points",
+             metric: str = "sqeuclidean", *, axis_name: str = AXIS,
              row_chunk: int | None = None, col_block: int | None = None,
              tiles=None):
     """Exact kNN of the local row shard against the GLOBAL point set.
@@ -132,7 +133,7 @@ def ring_knn(x_local: jnp.ndarray, k: int, n_shards: int, n_global: int,
 def project_knn_sharded(x_local: jnp.ndarray, k: int, n_shards: int,
                         n_global: int, metric: str = "sqeuclidean",
                         rounds: int = 3, key: jax.Array | None = None, *,
-                        axis_name: str = "points", proj_dims: int = 3,
+                        axis_name: str = AXIS, proj_dims: int = 3,
                         block: int | None = None, refine_rounds: int = 0,
                         refine_sample: int = 8, tiles=None):
     """Sharded approximate kNN: random-shift Morton rounds + banded re-rank,

@@ -1,4 +1,5 @@
-"""Point-axis SPMD: mesh construction, padding, and the sharded optimize runner.
+"""graftmesh: the ONE mesh-parametric pipeline core — mesh plans, spec
+layout, padding, and the sharded optimize runner.
 
 The reference scales by hash-sharding the point axis across Flink task
 managers, with broadcast joins for global state (SURVEY §2.2).  The TPU
@@ -9,10 +10,25 @@ equivalent is a 1-D device mesh over the ``points`` axis:
 * the reference's full-embedding broadcast (``TsneHelpers.scala:277-278``, its
   memory wall) becomes one ``lax.all_gather`` of the tiny [N, m] embedding over
   ICI per iteration;
-* Flink's global reduces (Z, ΣP, mean, loss — SURVEY §2.2) become ``lax.psum``.
+* Flink's global reduces (Z, ΣP, mean, loss — SURVEY §2.2) become gathered
+  mesh-canonical reductions (``models/tsne._mesh_sum``) for the floating
+  sums and ``lax.psum``/``pmax`` for the exact (integer / min-max) ones.
 
-N is padded to a multiple of the mesh size; padded points carry a ``valid=False``
-mask that removes them from Z, the loss, and the centering statistics.
+Since graftmesh there is no separate single-chip program: a
+:class:`MeshPlan` with one device is the TRIVIAL mesh and runs the very
+same ``shard_map`` program (collectives over a 1-wide axis lower to
+no-ops).  N is padded to a multiple of ``lcm(devices, PAD_QUANTUM)``;
+padded points carry a ``valid=False`` mask that removes them from Z, the
+loss, and the centering statistics.  Because the padded length — and with
+it every array shape and reduction order in the program — is identical
+for every mesh width dividing :data:`PAD_QUANTUM`, a D-device run is
+BIT-IDENTICAL to the 1-device run (pinned by tests/test_mesh.py): the
+portable-checkpoint and fleet-vs-solo contracts ride on this.
+
+This module is also the ONLY place axis names and ``PartitionSpec``s are
+made (the ``mesh-hygiene`` lint rule enforces it): consumers take their
+specs from :func:`pspec` / :func:`rspec` / :func:`state_pspec` and their
+mesh from a :class:`MeshPlan`.
 
 Multi-host: :func:`distributed_init` wraps ``jax.distributed.initialize`` —
 the DCN analog of the reference's Akka/Netty runtime bring-up.  The same
@@ -23,6 +39,7 @@ collectives over ICI within a slice and DCN across hosts.
 from __future__ import annotations
 
 import math
+from dataclasses import dataclass
 from functools import partial
 
 import numpy as np
@@ -37,6 +54,70 @@ from tsne_flink_tpu.models.tsne import (TELEMETRY_FIELDS, TsneConfig,
                                         TsneState, optimize)
 
 AXIS = "points"
+
+#: canonical row-padding quantum: every mesh pads N to a multiple of
+#: ``lcm(devices, PAD_QUANTUM)``, so all mesh widths DIVIDING this quantum
+#: (1, 2, 4, 8 — a v5e-8 slice included) run programs with identical array
+#: shapes, and with the mesh-canonical reductions (models/tsne._mesh_sum)
+#: identical bits.  Widths beyond the quantum still run correctly; only
+#: the cross-width bit-identity guarantee narrows to widths sharing the
+#: same lcm.
+PAD_QUANTUM = 8
+
+
+def pspec() -> P:
+    """The point-sharded PartitionSpec — rows split over the mesh axis."""
+    return P(AXIS)
+
+
+def rspec() -> P:
+    """The replicated PartitionSpec (global scalars / reduced outputs)."""
+    return P()
+
+
+def state_pspec() -> TsneState:
+    """The optimizer working set's spec tree: every array point-sharded."""
+    return TsneState(y=pspec(), update=pspec(), gains=pspec())
+
+
+def padded_rows_for(n: int, n_devices: int) -> int:
+    """The canonical padded row count for ``n`` points on an
+    ``n_devices``-wide mesh (see :data:`PAD_QUANTUM`)."""
+    q = math.lcm(max(1, int(n_devices)), PAD_QUANTUM)
+    return math.ceil(n / q) * q
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """One mesh choice, statically described — the parameter that makes the
+    pipeline mesh-parametric (ROADMAP item 1).  ``devices=None`` means all
+    visible devices; ``devices=1`` is the trivial mesh (the former
+    single-chip path, now just a width).  Threads from the CLI
+    (``--mesh``) / estimator (``TSNE(mesh=...)``) through prepare and
+    optimize, and stamps bench records / AOT keys / graftcheck plans via
+    :meth:`as_record`.
+    """
+
+    devices: int | None = None
+
+    def n_devices(self) -> int:
+        if self.devices is not None:
+            return int(self.devices)
+        return len(jax.devices())
+
+    def build(self) -> Mesh:
+        return make_mesh(self.devices)
+
+    def n_padded(self, n: int) -> int:
+        return padded_rows_for(n, self.n_devices())
+
+    def n_local(self, n: int) -> int:
+        return self.n_padded(n) // self.n_devices()
+
+    def as_record(self) -> dict:
+        """JSON-safe identity for bench records and cache keys."""
+        return {"devices": self.n_devices(), "axis": AXIS,
+                "pad_quantum": PAD_QUANTUM}
 
 
 def distributed_init(coordinator: str | None = None, num_processes: int | None = None,
@@ -62,8 +143,10 @@ def pad_rows(a: jnp.ndarray, n_pad: int, fill=0):
 
 class ShardedOptimizer:
     """Callable running :func:`tsne_flink_tpu.models.tsne.optimize` under
-    shard_map on a 1-D point mesh.  With one device it degrades to plain jit
-    of the identical program.
+    shard_map on a 1-D point mesh.  One device is the TRIVIAL mesh: the
+    IDENTICAL program (same shapes, same mesh-canonical reductions), so a
+    D-device run is bit-identical to the 1-device run for widths sharing
+    the padding quantum (graftmesh; pinned by tests/test_mesh.py).
 
     Supports segmented execution for checkpoint/resume: the compiled program
     takes a traced ``start_iter`` and a partially-filled loss trace, so the
@@ -72,14 +155,26 @@ class ShardedOptimizer:
     """
 
     def __init__(self, cfg: TsneConfig, n: int, n_devices: int | None = None,
-                 aot_plan=None):
-        self.cfg = cfg
+                 aot_plan=None, mesh: MeshPlan | None = None):
         self.n = n
-        self.mesh = make_mesh(n_devices)
+        #: the MeshPlan this optimizer runs on; ``n_devices`` stays as the
+        #: positional back-compat spelling (a bare width)
+        self.plan = mesh if mesh is not None else MeshPlan(devices=n_devices)
+        self.mesh = self.plan.build()
         self.n_devices = self.mesh.devices.size
-        d = self.n_devices
-        self.n_padded = math.ceil(n / d) * d
-        self.n_local = self.n_padded // d
+        self.n_padded = padded_rows_for(n, self.n_devices)
+        self.n_local = self.n_padded // self.n_devices
+        # canonical chunk clamp (graftmesh bit-identity): the per-row tile
+        # row count entering the repulsion/attraction sweeps must be
+        # mesh-invariant — a [c, N] matmul's per-row bits depend on c — so
+        # row_chunk is clamped to the QUANTUM-width local size; every mesh
+        # width sharing the quantum then tiles identically.  Production
+        # shapes are unaffected (row_chunk 2048 <= n/8 from n = 16k up).
+        c_max = max(1, self.n_padded // PAD_QUANTUM)
+        if cfg.row_chunk > c_max:
+            from dataclasses import replace
+            cfg = replace(cfg, row_chunk=c_max)
+        self.cfg = cfg
         self._fns = {}  # num_iters (static) -> compiled segment runner
         #: graftcheck PlanConfig identifying this run for the AOT
         #: executable cache (utils/aot.py): with it, each segment
@@ -113,57 +208,59 @@ class ShardedOptimizer:
         if key in self._fns:
             return self._fns[key]
         cfg_ = self.cfg
-        if self.n_devices == 1:
-            # graftlint: disable=jit-hygiene -- the segment-input state must
-            # NOT be donated: checkpoint_cb retains it between segments for
-            # the deadline-stop resume (bench.py cb keeps prog["state"]), so
-            # donation would hand XLA a buffer the host still reads
-            fn = jax.jit(partial(optimize, cfg=cfg_, num_iters=num_iters,
-                                 edges_extra=edges_extra,
-                                 with_health=with_health,
-                                 with_telemetry=with_telemetry))
-        else:
-            n_local = self.n_local
+        n_local = self.n_local
 
-            def local_run(state, jidx, jval, valid, start_iter, loss_carry,
-                          *rest):
-                rest = list(rest)
-                edges = rest.pop(0) if with_edges else None
-                tel_carry = rest.pop(0) if with_telemetry else None
-                row_offset = lax.axis_index(AXIS) * n_local
-                if edges is None and trace_edge_pad is not None:
-                    from tsne_flink_tpu.ops.affinities import assemble_edges
-                    edges = assemble_edges(jidx, jval, trace_edge_pad)
-                return optimize(state, jidx, jval, cfg_, axis_name=AXIS,
-                                row_offset=row_offset, valid=valid,
-                                start_iter=start_iter, num_iters=num_iters,
-                                loss_carry=loss_carry, edges=edges,
-                                edges_extra=edges_extra,
-                                with_health=with_health,
-                                with_telemetry=with_telemetry,
-                                telemetry_carry=tel_carry)
+        def local_run(state, jidx, jval, valid, start_iter, loss_carry,
+                      *rest):
+            rest = list(rest)
+            edges = rest.pop(0) if with_edges else None
+            tel_carry = rest.pop(0) if with_telemetry else None
+            row_offset = lax.axis_index(AXIS) * n_local
+            if edges is None and trace_edge_pad is not None:
+                from tsne_flink_tpu.ops.affinities import assemble_edges
+                edges = assemble_edges(jidx, jval, trace_edge_pad)
+            return optimize(state, jidx, jval, cfg_, axis_name=AXIS,
+                            row_offset=row_offset, valid=valid,
+                            start_iter=start_iter, num_iters=num_iters,
+                            loss_carry=loss_carry, edges=edges,
+                            edges_extra=edges_extra,
+                            with_health=with_health,
+                            with_telemetry=with_telemetry,
+                            telemetry_carry=tel_carry)
 
-            pspec = P(AXIS)
-            state_spec = TsneState(y=pspec, update=pspec, gains=pspec)
-            in_specs = [state_spec, pspec, pspec, pspec, P(), P()]
-            if with_edges:
-                in_specs.append((pspec, pspec, pspec))
+        in_specs = [state_pspec(), pspec(), pspec(), pspec(), rspec(),
+                    rspec()]
+        if with_edges:
+            in_specs.append((pspec(), pspec(), pspec()))
+        if with_telemetry:
+            in_specs.append(rspec())  # telemetry carry is replicated
+        # loss trace (and the telemetry rows / sentinel flag) are
+        # mesh-canonically reduced / pmin-pmax replicated global values
+        outs = [state_pspec(), rspec()]
+        if with_telemetry:
+            outs.append(rspec())
+        if with_health:
+            outs.append(rspec())
+        # donated carry buffers (graftmesh perf): the state and the loss /
+        # telemetry carries are re-bound every segment, so XLA may reuse
+        # their HBM in place.  NOT under health_check — the rollback path
+        # re-reads the pre-segment state — and not on CPU, whose runtime
+        # cannot donate (it would warn on every call); checkpoint callbacks
+        # only ever see UNPADDED slices (fresh buffers), never the donated
+        # padded arrays.
+        donate: tuple = ()
+        if jax.default_backend() != "cpu" and not with_health:
+            donate = (0, 5)
             if with_telemetry:
-                in_specs.append(P())  # telemetry carry is replicated
-            # loss trace (and the telemetry rows / sentinel flag) are
-            # psum/pmin/pmax-replicated global scalars
-            outs = [state_spec, P()]
-            if with_telemetry:
-                outs.append(P())
-            if with_health:
-                outs.append(P())
-            from tsne_flink_tpu.utils.compat import shard_map
-            fn = jax.jit(
-                shard_map(
-                    local_run, mesh=self.mesh,
-                    in_specs=tuple(in_specs),
-                    out_specs=tuple(outs),
-                ))
+                donate = donate + (6 + int(with_edges),)
+        from tsne_flink_tpu.utils.compat import shard_map
+        fn = jax.jit(
+            shard_map(
+                local_run, mesh=self.mesh,
+                in_specs=tuple(in_specs),
+                out_specs=tuple(outs),
+            ),
+            donate_argnums=donate)
         self._fns[key] = fn
         return fn
 
@@ -179,6 +276,7 @@ class ShardedOptimizer:
             wrapped = aot.wrap(fn, {**aot.plan_key_parts(self.aot_plan),
                                     "n": self.n,
                                     "devices": self.n_devices,
+                                    "mesh": self.plan.as_record(),
                                     "segment": repr(key),
                                     "cfg": repr(self.cfg)},
                                "optimize-seg")
@@ -199,29 +297,30 @@ class ShardedOptimizer:
             jval = pad_rows(jval, self.n_padded - jval.shape[0])
         s = jidx.shape[1]
         if mode == "rows":
-            # must short-circuit BEFORE the per-shard plans: plan_edges
+            # must short-circuit BEFORE the edge sizing: plan_edges
             # reports e_pad=0 for "rows", which the benefit gate below would
             # misread as "zero edges — beneficial"
             return "rows", self.n_padded * s, 0
-        if self.n_devices == 1:
-            use, e_pad = plan_edges(jidx, jval, mode)
-            return (("edges", e_pad, e_pad) if use
-                    else ("rows", self.n_padded * s, 0))
-        from tsne_flink_tpu.ops.affinities import edges_beneficial
+        from tsne_flink_tpu.ops.affinities import (edge_count,
+                                                   edges_beneficial)
         nl = self.n_local
         if mode == "auto" and nl * s >= 2 ** 31:
-            # per-shard conversion would overflow int32 slots: every shard's
-            # plan_edges declines with e_pad=0, which must not read as
-            # "zero edges, beneficial" below
+            # per-shard conversion would overflow int32 slots
             return "rows", self.n_padded * s, 0
+        # the LAYOUT decision is gated on GLOBAL quantities (graftmesh): a
+        # per-shard gate near the benefit boundary could pick rows on one
+        # mesh width and edges on another, breaking the bit-identity
+        # contract — every width must agree before per-shard sizing
+        e_global = int(edge_count(jval, multiple=1024))
+        if mode == "auto" and not edges_beneficial(e_global, self.n_padded,
+                                                   s):
+            return "rows", self.n_padded * s, 0
+        # per-shard pad: every shard carries the same static edge length
         plans = [plan_edges(jidx[d * nl:(d + 1) * nl],
-                            jval[d * nl:(d + 1) * nl], mode)
+                            jval[d * nl:(d + 1) * nl], "edges")
                  for d in range(self.n_devices)]
         e_local = max(e for _, e in plans)
-        # one static per-shard size: every shard must agree on the layout
-        if mode == "edges" or edges_beneficial(e_local, nl, s):
-            return "edges", e_local * self.n_devices, e_local
-        return "rows", self.n_padded * s, 0
+        return "edges", e_local * self.n_devices, e_local
 
     def _build_edges(self, jidx, jval):
         """Host-side prep: padded rows -> per-shard flat COO edge arrays with
@@ -232,8 +331,6 @@ class ShardedOptimizer:
         layout, _, e_pad = self.attraction_plan(jidx, jval)
         if layout != "edges":
             return None
-        if self.n_devices == 1:
-            return jax.jit(partial(assemble_edges, e_pad=e_pad))(jidx, jval)
         nl = self.n_local
         conv = jax.jit(partial(assemble_edges, e_pad=e_pad))
         parts = [conv(jidx[d * nl:(d + 1) * nl], jval[d * nl:(d + 1) * nl])
@@ -246,10 +343,8 @@ class ShardedOptimizer:
         blocks analog of :meth:`attraction_plan`'s invariant (the bench's
         FLOP/MFU model must count what actually runs).  Multi-device
         meshes launch the re-padded per-shard blocks, not the global
-        edge list."""
+        edge list (one device = the trivial mesh: same formula)."""
         s = int(jidx.shape[1])
-        if self.n_devices == 1:
-            return self.n * s + int(extra_edges[0].shape[0])
         shards = self._shard_reverse_block(extra_edges)
         return self.n_padded * s + int(shards[0].shape[0])
 
@@ -303,12 +398,6 @@ class ShardedOptimizer:
         """AOT-lower the SAME program __call__ would run — including the
         attraction layout, so an --executionPlan dump shows the real
         attraction sweep, not unconditionally the rows one."""
-        if self.n_devices == 1:
-            edges = self._build_edges(jidx, jval)
-            fn = self._segment_fn(self.cfg.iterations)
-            return fn.lower(state, jidx, jval, start_iter=0,
-                            loss_carry=self._loss0(state.y.dtype),
-                            edges=edges)
         state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
         edges = self._build_edges(jidx, jval)
         fn = self._segment_fn(self.cfg.iterations,
@@ -318,11 +407,6 @@ class ShardedOptimizer:
 
     def _run_segment(self, fn, state, jidx, jval, valid, start, losses,
                      edges=None, tel=None, telemetry: bool = False):
-        if self.n_devices == 1:
-            kw = dict(start_iter=start, loss_carry=losses, edges=edges)
-            if telemetry:
-                kw["telemetry_carry"] = tel
-            return fn(state, jidx, jval, **kw)
         args = [state, jidx, jval, valid, start, losses]
         if edges is not None:
             args.append(edges)
@@ -388,8 +472,6 @@ class ShardedOptimizer:
                 "global reverse block — use the rows/alltoall SPMD path")
         if pre_padded_valid is not None:
             valid = pre_padded_valid
-        elif self.n_devices == 1:
-            valid = None
         else:
             state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
         if loss_carry is not None:
@@ -422,8 +504,7 @@ class ShardedOptimizer:
                       "per-shard conversion would overflow int32 slots); "
                       "running the rows layout", file=sys.stderr)
         elif extra_edges is not None:
-            edges = (tuple(extra_edges) if self.n_devices == 1
-                     else self._shard_reverse_block(extra_edges))
+            edges = self._shard_reverse_block(extra_edges)
         else:
             edges = self._build_edges(jidx, jval)
         tel = None
@@ -519,5 +600,6 @@ class ShardedOptimizer:
 
 
 def shard_pipeline(cfg: TsneConfig, n: int, n_devices: int | None = None,
-                   aot_plan=None) -> ShardedOptimizer:
-    return ShardedOptimizer(cfg, n, n_devices, aot_plan=aot_plan)
+                   aot_plan=None, mesh: MeshPlan | None = None
+                   ) -> ShardedOptimizer:
+    return ShardedOptimizer(cfg, n, n_devices, aot_plan=aot_plan, mesh=mesh)

@@ -32,11 +32,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from tsne_flink_tpu.ops.affinities import P_FLOOR, assemble_rows
+from tsne_flink_tpu.parallel.mesh import AXIS
 
 
 def symmetrize_alltoall(idx: jnp.ndarray, p: jnp.ndarray, n_shards: int,
                         sym_width: int, *,
-                        slack: int = 4, axis_name: str = "points"):
+                        slack: int = 4, axis_name: str = AXIS):
     """Sharded P + Pᵀ with routed transpose edges; runs inside ``shard_map``.
 
     ``idx`` [n_local, k] holds GLOBAL neighbor ids, ``p`` [n_local, k] the

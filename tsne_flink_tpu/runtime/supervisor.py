@@ -246,7 +246,7 @@ class Supervisor:
 
 def run_plan_from_fit(n: int, d: int, k: int, cfg, assembly: str,
                       knn_method: str, knn_rounds=None, knn_refine=None,
-                      sym_width=None, name: str = "fit"):
+                      sym_width=None, mesh: int = 1, name: str = "fit"):
     """A graftcheck PlanConfig for an in-process fit — the estimator's
     analog of the CLI's ``_run_plan`` (the ladder's input)."""
     import jax
@@ -258,7 +258,7 @@ def run_plan_from_fit(n: int, d: int, k: int, cfg, assembly: str,
         knn_method=knn_method, knn_rounds=knn_rounds, knn_refine=knn_refine,
         repulsion=cfg.repulsion, theta=cfg.theta, assembly=assembly,
         attraction=cfg.attraction, sym_width=sym_width,
-        row_chunk=cfg.row_chunk, name=name)
+        row_chunk=cfg.row_chunk, mesh=int(mesh), name=name)
 
 
 def supervised_embed(x, cfg, *, supervisor: Supervisor,
@@ -268,13 +268,14 @@ def supervised_embed(x, cfg, *, supervisor: Supervisor,
                      sym_width=None, affinity_assembly=None,
                      artifact_cache=None, knn_autotune: bool = False,
                      telemetry: bool = False, on_stage=None,
-                     checkpoint_cb=None):
-    """Supervised single-device pipeline: ``models/tsne.tsne_embed`` with
-    the supervisor wrapped around prepare and a segmented optimizer run
-    (the sentinel needs segment boundaries to roll back to).  Same key
-    derivation and prepare plan as ``tsne_embed``; the optimize loop runs
-    through ``ShardedOptimizer`` on one device — the same compiled
-    program, segmented.
+                     checkpoint_cb=None, mesh_devices: int = 1):
+    """Supervised mesh-parametric pipeline: ``models/tsne.tsne_embed``'s
+    prepare plan with the supervisor wrapped around prepare and a
+    segmented optimizer run (the sentinel needs segment boundaries to
+    roll back to).  Same key derivation and prepare plan as
+    ``tsne_embed``; the optimize loop runs through the unified
+    ``ShardedOptimizer`` on a ``mesh_devices``-wide mesh (graftmesh;
+    1 = the trivial mesh) — the same compiled program, segmented.
 
     ``on_stage(name, seconds, cache_state)`` / ``checkpoint_cb(state,
     next_iter, losses)`` are progress hooks at prepare-stage completions
@@ -312,7 +313,7 @@ def supervised_embed(x, cfg, *, supervisor: Supervisor,
     iters = cfg.iterations
     seg = max(LOSS_EVERY, min(50, iters // 10 or iters))
     state, losses = supervisor.run_optimize(
-        lambda c: ShardedOptimizer(c, n, n_devices=1), cfg, state,
+        lambda c: ShardedOptimizer(c, n, n_devices=mesh_devices), cfg, state,
         prep.jidx, prep.jval, extra_edges=prep.extra_edges,
         checkpoint_every=seg,
         checkpoint_cb=checkpoint_cb or (lambda *a: None),

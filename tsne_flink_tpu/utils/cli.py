@@ -117,6 +117,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "explicitly to pin pure-f32 matmuls")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size over the point axis (default: all)")
+    p.add_argument("--mesh", type=int, default=None,
+                   help="graftmesh: run the ONE mesh-parametric pipeline "
+                        "over an N-wide point mesh (1 device = the trivial "
+                        "mesh — same program, same bits; widths sharing the "
+                        "padding quantum produce bit-identical embeddings, "
+                        "so a checkpoint written at --mesh 1 resumes "
+                        "bit-identically at --mesh 4 and back). Default: "
+                        "--devices (all visible devices)")
     p.add_argument("--symWidth", type=int, default=None,
                    help="(--spmd only) static symmetrized P-row width; "
                         "default 2*neighbors. Rows whose symmetrized degree "
@@ -140,10 +148,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "ANY edge (all_to_all capacity cap or sym_width row "
                         "overflow) instead of warning — drops alter P")
     p.add_argument("--spmd", action="store_true",
-                   help="run the WHOLE pipeline (kNN, affinities, optimize) "
-                        "as one sharded program on the mesh — kNN over the "
-                        "ppermute ring / sharded Morton bands instead of "
-                        "single-device; required once N outgrows one chip")
+                   help="DEPRECATED alias of --mesh N (graftmesh collapsed "
+                        "the two pipelines into one): single-controller "
+                        "--spmd now runs the unified mesh pipeline over all "
+                        "devices with a warning. Only multi-controller jobs "
+                        "(--coordinator/--numProcesses/--processId) still "
+                        "route through the SpmdPipeline compatibility "
+                        "wrapper, whose in-trace sharded prepare is the one "
+                        "form non-addressable global arrays permit")
     p.add_argument("--checkpoint", default=None,
                    help="path prefix for periodic (y, update, gains, iter) "
                         "checkpoints — capability-add over the reference. "
@@ -337,6 +349,7 @@ def _run_plan(args, cfg, n: int, assembly: str, neighbors: int):
     import jax
 
     from tsne_flink_tpu.analysis.audit import PlanConfig
+    mesh_n = args.mesh if args.mesh is not None else args.devices
     return PlanConfig(
         n=n, d=int(args.dimension), k=int(neighbors),
         backend=jax.default_backend(),
@@ -348,6 +361,7 @@ def _run_plan(args, cfg, n: int, assembly: str, neighbors: int):
         repulsion=cfg.repulsion, theta=cfg.theta,
         assembly=assembly, attraction=cfg.attraction,
         sym_width=args.symWidth, row_chunk=cfg.row_chunk,
+        mesh=int(mesh_n) if mesh_n else jax.device_count(),
         name="cli-launch")
 
 
@@ -651,28 +665,39 @@ def _main(argv=None, sp_run=None) -> int:
     from tsne_flink_tpu.utils import io as tio
     from tsne_flink_tpu.parallel.mesh import shard_pipeline
 
+    # graftmesh: --spmd is a deprecated alias of --mesh.  The ONLY runs
+    # still routed through the SpmdPipeline compatibility wrapper are
+    # multi-CONTROLLER jobs (their non-addressable arrays need the
+    # in-trace sharded prepare); every single-controller invocation —
+    # --mesh N, bare --spmd, or neither — runs the ONE unified pipeline
+    # (host-staged prepare + mesh-parametric ShardedOptimizer).  The old
+    # --spmd-rejects---affinityAssembly guard is gone with the seam it
+    # papered over: assembly overrides now genuinely apply under any mesh.
+    multi_controller = any(v is not None for v in multihost)
+    if args.spmd and not multi_controller:
+        print("WARNING: --spmd is deprecated — the pipeline is "
+              "mesh-parametric (graftmesh); use --mesh N instead. "
+              "Aliasing to --mesh over "
+              + (f"{args.devices}" if args.devices else "all")
+              + " device(s); --symMode/--symSlack/--symStrict only apply "
+              "to multi-controller jobs now", file=sys.stderr)
+    mesh_devices = args.mesh if args.mesh is not None else args.devices
+
     # resolve the assembly BEFORE the input parse and kNN stages: an
     # unsupported combination (or an env typo) must fail in milliseconds,
     # not after minutes of chip time (code-review r5, twice)
-    if args.affinityAssembly is not None and args.spmd:
-        # mirror models/api.py (ADVICE r5 #2): the spmd pipeline symmetrizes
-        # with its own replicated/alltoall strategies (--symMode), so ANY
-        # explicit assembly override — not just blocks — would be dropped on
-        # the floor and a CLI builder A/B under --spmd would silently
-        # measure the wrong path.  Refuse instead.
-        raise SystemExit(f"--affinityAssembly {args.affinityAssembly} has "
-                         "no effect with --spmd (symmetrization is chosen "
-                         "by --symMode there); drop the flag")
     assembly = args.affinityAssembly or env_str("TSNE_AFFINITY_ASSEMBLY")
     if assembly not in ("auto", "sorted", "split", "blocks"):
         raise SystemExit(f"TSNE_AFFINITY_ASSEMBLY '{assembly}' not defined "
                          "(auto | sorted | split | blocks)")
-    if assembly in ("sorted", "split") and args.spmd:
-        # env-sourced override: same no-effect situation, but an ambient env
-        # var should not kill a job — warn loudly instead (blocks still
-        # refuses below: an env user asked for a layout spmd cannot run)
-        print(f"# TSNE_AFFINITY_ASSEMBLY={assembly} is ignored with --spmd "
-              "(symmetrization is chosen by --symMode)", file=sys.stderr)
+    if assembly in ("sorted", "split") and multi_controller:
+        # the multi-controller wrapper symmetrizes with its own
+        # replicated/alltoall strategies (--symMode): an ambient env var
+        # should not kill a job — warn loudly instead (blocks still
+        # refuses below: an env user asked for a layout it cannot run)
+        print(f"# TSNE_AFFINITY_ASSEMBLY={assembly} is ignored in "
+              "multi-controller jobs (symmetrization is chosen by "
+              "--symMode)", file=sys.stderr)
         assembly = "auto"
     if assembly == "auto" and args.executionPlan:
         # the plan dump wants a lowerable rows program, and auto's choice
@@ -682,21 +707,16 @@ def _main(argv=None, sp_run=None) -> int:
               "blocks layout has no lowered-plan form)", file=sys.stderr)
         assembly = "sorted"
     if assembly == "blocks":
-        if args.spmd:
-            raise SystemExit("--affinityAssembly blocks does not apply to "
-                             "--spmd (that pipeline symmetrizes with its "
-                             "own replicated/alltoall strategies, "
-                             "--symMode); drop --spmd to use blocks — it "
-                             "runs on any single-controller mesh width")
         if args.executionPlan:
             raise SystemExit("--affinityAssembly blocks does not lower an "
                              "execution plan; use sorted or split for "
                              "--executionPlan")
-        if any(v is not None for v in multihost):
+        if multi_controller:
             raise SystemExit("--affinityAssembly blocks is "
                              "single-controller (the host re-slices the "
                              "reverse block per shard, which is impossible "
-                             "on non-addressable multi-controller arrays)")
+                             "on non-addressable multi-controller arrays); "
+                             "it runs on any single-controller mesh width")
 
     dtype_explicit = args.dtype is not None
     args.dtype = args.dtype or "float32"
@@ -800,10 +820,11 @@ def _main(argv=None, sp_run=None) -> int:
                             max_retries=args.maxRetries, on_oom=args.onOom,
                             health_check=args.healthCheck)
 
-    if args.spmd:
-        # the whole job as ONE sharded program (SpmdPipeline); with
-        # --checkpoint/--resume it switches to the segmented prepare+optimize
-        # form with identical results
+    if multi_controller:
+        # multi-controller jobs: the SpmdPipeline compatibility wrapper —
+        # in-trace sharded prepare + the SAME unified ShardedOptimizer
+        # (run_checkpointable); single-controller --spmd no longer lands
+        # here (it is an alias of --mesh, handled below)
         from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
         pipe = SpmdPipeline(cfg, n, args.dimension, neighbors,
                             knn_method=spmd_knn_method,
@@ -812,7 +833,7 @@ def _main(argv=None, sp_run=None) -> int:
                             sym_width=args.symWidth, sym_mode=args.symMode,
                             sym_slack=args.symSlack,
                             sym_strict=args.symStrict,
-                            n_devices=args.devices,
+                            n_devices=mesh_devices,
                             artifact_cache=art_cache)
         if args.executionPlan:
             lowered = pipe.lower(spmd_data, key)
@@ -971,7 +992,7 @@ def _main(argv=None, sp_run=None) -> int:
         state = init_working_set(jax.random.key(args.randomState), n,
                                  cfg.n_components, dtype)
 
-    runner = shard_pipeline(cfg, n, n_devices=args.devices,
+    runner = shard_pipeline(cfg, n, n_devices=mesh_devices,
                             aot_plan=run_plan)
 
     if args.executionPlan:
@@ -992,7 +1013,7 @@ def _main(argv=None, sp_run=None) -> int:
         jax.profiler.start_trace(args.profile)
     state, losses = supervisor.run_optimize(
         lambda c: (runner if c is cfg
-                   else shard_pipeline(c, n, n_devices=args.devices,
+                   else shard_pipeline(c, n, n_devices=mesh_devices,
                                        aot_plan=run_plan)),
         cfg, state, jidx, jval, start_iter=start_iter,
         loss_carry=loss_carry, checkpoint_every=args.checkpointEvery,

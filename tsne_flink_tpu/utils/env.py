@@ -73,6 +73,13 @@ _declare("TSNE_MATMUL_F32", "bool", False,
 _declare("TSNE_QUALITY_BACKEND", "str", "cpu",
          "Backend the quality scripts (scripts/validate_quality.py, "
          "scripts/quality_60k.py) pin via jax_platforms.")
+_declare("TSNE_MESH", "int", 0,
+         "graftmesh: width of the 1-D point mesh bench.py runs the "
+         "optimize loop on (the CLI's --mesh). 0 = all visible devices. "
+         "1 device is the trivial mesh — same program; widths sharing the "
+         "padding quantum (parallel/mesh.PAD_QUANTUM) are bit-identical. "
+         "Every bench record carries the resolved mesh under the 'mesh' "
+         "key, and peak_flops scales with the mesh width.")
 
 # ---- affinity / kNN stage knobs -------------------------------------------
 _declare("TSNE_AFFINITY_ASSEMBLY", "str", "auto",

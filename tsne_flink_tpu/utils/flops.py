@@ -44,6 +44,13 @@ def peak_flops(backend: str, device_kind: str = "", devices: int = 1,
                cpu_cores: int | None = None):
     """Return (peak_flops_total, basis_string) for `devices` devices.
 
+    graftmesh: ``devices`` is the MESH width the run actually shards
+    over, not the host's device count — on TPU the peak scales with it
+    (each mesh device is real silicon), while on CPU the honest
+    denominator stays the host's cores (virtual mesh devices share
+    them; the basis string records the mesh so the record is still
+    self-describing).
+
     Unrecognized backends (e.g. gpu) return ``(None, ...)`` — the caller
     must report MFU as unknown rather than dividing by a made-up peak."""
     if backend == "tpu":
@@ -56,8 +63,12 @@ def peak_flops(backend: str, device_kind: str = "", devices: int = 1,
         if cpu_cores is None:
             import os
             cpu_cores = os.cpu_count() or 1
-        return _CPU_CORE_PEAK * cpu_cores, (
-            f"nominal f32 {_CPU_CORE_PEAK/1e9:.0f}GF/core x {cpu_cores} cores")
+        basis = (f"nominal f32 {_CPU_CORE_PEAK/1e9:.0f}GF/core x "
+                 f"{cpu_cores} cores")
+        if devices > 1:
+            basis += (f" (mesh {devices}: virtual CPU devices share the "
+                      "cores; peak not multiplied)")
+        return _CPU_CORE_PEAK * cpu_cores, basis
     return None, f"unrecognized backend '{backend}' — no peak model, MFU unknown"
 
 
