@@ -79,7 +79,7 @@ RECORD_BASE_KEYS = (
     "theta", "knn_method", "knn_rounds", "knn_refine", "data", "data_seed",
     "peak_flops", "peak_flops_basis", "assembly", "cache", "matmul_dtype",
     "knn_tiles", "audit", "degradations", "aot_cache", "memory",
-    "host_calib", "fleet", "mesh",
+    "host_calib", "fleet", "mesh", "kl", "repulsion_stride",
 )
 
 
@@ -238,6 +238,12 @@ def _latest_tpu_record():
     return best[1] if best else None
 
 
+def _att_kernel_label():
+    """The resolved fused-attraction kernel for this process (graftstep)."""
+    from tsne_flink_tpu.ops.attraction_pallas import pick_attraction_kernel
+    return pick_attraction_kernel()
+
+
 class _DeadlineStop(Exception):
     """Raised from the optimize checkpoint callback to stop segmenting."""
 
@@ -331,7 +337,8 @@ def main():
 
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=theta,
                      repulsion=repulsion, attraction=attraction,
-                     row_chunk=4096)
+                     row_chunk=4096,
+                     repulsion_stride=env_int("TSNE_REPULSION_STRIDE"))
     k = 90  # 3 * perplexity (Tsne.scala:55)
     # the same auto kNN policy the CLI runs, resolved up front so the
     # record, the FLOP model and the fingerprint all key the method that
@@ -384,8 +391,13 @@ def main():
                                        block=tile_plan.block,
                                        refine_rounds=refine)
     else:
-        # exact sweep: one substage, mirroring the dispatch's on_substage
-        f_knn_sub = {"exact": knn_flops(n, d_in, k, knn_method)}
+        # exact sweep, decomposed like the dispatch's on_substage stages
+        # (graftstep): the distance arithmetic is all in the sweep; the
+        # operand staging and the width-KPAD ordering pass are FLOP-noise
+        # by the model's dense-arithmetic convention (like zorder_sort)
+        f_knn_sub = {"exact_setup": 0.0,
+                     "exact_sweep": knn_flops(n, d_in, k, knn_method),
+                     "exact_topk": 0.0}
     f_knn = float(sum(f_knn_sub.values()))
     f_aff = affinity_flops(n, k)
     # graftmesh: the mesh width the optimize loop runs on (TSNE_MESH; 0 =
@@ -512,6 +524,14 @@ def main():
         # over ({devices, axis, pad_quantum} — parallel/mesh.MeshPlan);
         # peak_flops above is scaled by the SAME width
         "mesh": MeshPlan(devices=mesh_devices).as_record(),
+        # latest known KL (graftstep satellite: the r8 record carried no
+        # kl while the log quoted 4.717) — None until the first report
+        # slot lands, then updated at every optimize segment boundary and
+        # final on the last record
+        "kl": None,
+        # graftstep opt-in repulsion amortization cadence (1 = exact
+        # every-iteration recomputation, the default)
+        "repulsion_stride": cfg.repulsion_stride,
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
@@ -607,6 +627,14 @@ def main():
     runner = ShardedOptimizer(cfg, n, n_devices=mesh_devices,
                               aot_plan=_plan)
     s = int(jidx.shape[1])  # true symmetrized row width the optimizer runs
+    # graftstep: re-predict the optimize stage with the MEASURED hub width
+    # (the up-front plan only knows the 2k lower bound — the r8 record's
+    # 14.5x optimize drift was mostly this) so the recorded drift grades
+    # the informed model; the pre-launch audit gate above is untouched
+    from dataclasses import replace as _plan_replace
+    _hbm_opt = plan_hbm_report(_plan_replace(_plan, sym_width=s))
+    _pred_stage["optimize"] = int(
+        float(_hbm_opt["stages"]["optimize"]["peak"]) * _gib_b)
     # ask the optimizer which attraction layout it actually launches so the
     # FLOP model counts the launched pairs (utils/flops.py) — single- AND
     # multi-device (the decision lives in ONE place: affinities.plan_edges
@@ -618,7 +646,8 @@ def main():
         use_edges = True  # pair-count-based FLOP model, like edges
     else:
         layout, pairs, _ = runner.attraction_plan(jidx, jval)
-        use_edges = layout == "edges"
+        # csr launches head slots + tail entries — a pair count, like edges
+        use_edges = layout in ("edges", "csr")
     f_opt = optimize_flops(n, s, 2, iters, repulsion,
                            nnz_pairs=pairs if use_edges else None,
                            theta=cfg.theta,  # bh auto-frontier mirror
@@ -658,6 +687,11 @@ def main():
         prog.update(it=next_iter, state=state_u, losses=losses,
                     last_seg_s=now - prog["t_prev"], t_prev=now)
         mem_mark("optimize")
+        slot = next_iter // LOSS_EVERY - 1
+        if slot >= 0 and losses is not None:
+            # latest recorded KL rides every superseding record
+            base["kl"] = round(
+                float(losses[min(slot, losses.shape[0] - 1)]), 4)
         measured = t_knn + t_aff + now
         emit_partial(measured, est_total_at(next_iter),
                      {"knn": t_knn, "affinities": t_aff,
@@ -693,6 +727,7 @@ def main():
     kl_slot = it_done // LOSS_EVERY - 1
     final_kl = float(losses[min(kl_slot, losses.shape[0] - 1)]) \
         if kl_slot >= 0 else None
+    base["kl"] = round(final_kl, 4) if final_kl is not None else None
     print(f"# knn={t_knn:.2f}s affinities={t_aff:.2f}s optimize={t_opt:.2f}s "
           f"({it_done}/{iters} iters, {jax.device_count()} "
           f"{jax.default_backend()} device(s)), KL={final_kl}",
@@ -736,6 +771,9 @@ def main():
                             "affinities": prep.affinity_cache},
            "final_kl": round(final_kl, 4) if final_kl is not None else None,
            "sym_width": s, "attraction": layout, "attraction_pairs": pairs,
+           # the resolved attraction kernel policy (graftstep; recorded
+           # like knn_tiles.kernel so the record says what actually ran)
+           "attraction_kernel": _att_kernel_label(),
            # supervisor history: ladder steps + every recovery decision
            # (oom / degrade / relaunch / sentinel-rollback events)
            "degradations": sup.degradations, "runtime_events": sup.events,

@@ -8,11 +8,20 @@ present.  The terminal twin of loading the trace in Perfetto.
 
 Usage:
   python scripts/trace_report.py <trace.json|trace.jsonl> [--json]
+  python scripts/trace_report.py --memory <bench_record.json> [--json]
   python scripts/trace_report.py --smoke
+
+``--memory`` (graftstep satellite): reads a bench RECORD (a results/*.json
+file — a plain JSON object or JSON-lines whose last line is the record)
+and renders its predicted-vs-observed memory block as a per-stage table
+(predicted bytes, observed watermark, drift ratio), warning on any stage
+whose drift exceeds :data:`DRIFT_WARN` — the terminal face of the
+graftcheck HBM model's feedback loop.
 
 ``--smoke`` (tier-1, tests/test_obs.py): generates a tiny in-process
 trace with the real tracer, writes it to a temp file, and reports on it —
-proving the emit -> load -> aggregate loop end to end without JAX.
+plus a synthetic memory table — proving the emit -> load -> aggregate
+loop end to end without JAX.
 """
 
 from __future__ import annotations
@@ -107,6 +116,87 @@ def render(summary: dict) -> str:
     return "\n".join(lines)
 
 
+#: drift ratio above which a stage line gets a WARN flag — the same 3x
+#: bound the bench-contract drift gate enforces on committed records
+#: (tests/test_bench_contract.py).
+DRIFT_WARN = 3.0
+
+
+def load_record(path: str) -> dict:
+    """A bench record from ``path``: a plain JSON object, or JSON-lines
+    (bench stdout capture) whose LAST parseable object wins."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            return obj
+        raise ValueError(f"{path}: top-level JSON is not an object")
+    except json.JSONDecodeError:
+        rec = None
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        if rec is None:
+            raise ValueError(f"{path}: no JSON record found")
+        return rec
+
+
+def memory_summary(rec: dict) -> dict:
+    """Normalized rows from a record's ``memory`` block:
+    {"basis", "rows": [{stage, predicted, observed, drift, warn}],
+    "peak": {...}, "warnings": [...]}."""
+    mem = rec.get("memory") or {}
+    rows, warnings = [], []
+    for stage, st in (mem.get("stages") or {}).items():
+        drift = st.get("drift")
+        warn = drift is not None and drift > DRIFT_WARN
+        rows.append({"stage": stage,
+                     "predicted": st.get("predicted_bytes"),
+                     "observed": st.get("observed_bytes"),
+                     "drift": drift, "warn": warn})
+        if warn:
+            warnings.append(
+                f"stage '{stage}' drift {drift}x exceeds {DRIFT_WARN}x — "
+                "the HBM model is missing a live term (or the stage is "
+                "allocating something it should not)")
+    peak = {"predicted": mem.get("predicted_peak"),
+            "observed": mem.get("observed_peak"),
+            "drift": mem.get("drift")}
+    return {"basis": mem.get("basis"), "rows": rows, "peak": peak,
+            "warnings": warnings}
+
+
+def render_memory(summary: dict) -> str:
+    rows = summary["rows"]
+    if not rows:
+        return "trace_report: record carries no per-stage memory block"
+
+    def gib(b):
+        return "-" if b is None else f"{b / (1 << 30):.3f}"
+
+    lines = [f"memory (basis: {summary['basis'] or '?'}), GiB "
+             f"predicted vs observed watermark:",
+             f"{'stage':<12} {'predicted':>10} {'observed':>10} "
+             f"{'drift':>7}  flags"]
+    for r in rows:
+        drift = "-" if r["drift"] is None else f"{r['drift']:.2f}x"
+        lines.append(f"{r['stage']:<12} {gib(r['predicted']):>10} "
+                     f"{gib(r['observed']):>10} {drift:>7}"
+                     f"  {'WARN drift>' + str(DRIFT_WARN) if r['warn'] else ''}")
+    p = summary["peak"]
+    drift = "-" if p["drift"] is None else f"{p['drift']:.2f}x"
+    lines.append(f"{'peak':<12} {gib(p['predicted']):>10} "
+                 f"{gib(p['observed']):>10} {drift:>7}")
+    for w in summary["warnings"]:
+        lines.append(f"WARNING: {w}")
+    return "\n".join(lines)
+
+
 def _smoke(out_json: bool) -> int:
     """Emit a real (tiny) trace through the tracer and report on it —
     the tier-1 pin that the whole export/report loop works, JAX-free."""
@@ -131,15 +221,33 @@ def _smoke(out_json: bool) -> int:
         summary = summarize(load_events(path))
     trace.set_enabled(None)
     trace.reset()
+    # the --memory path, end to end on a synthetic record: one in-bound
+    # stage, one drift-warned stage
+    rec = {"memory": {"basis": "rss", "predicted_peak": 4 << 28,
+                      "observed_peak": 5 << 28, "drift": 1.25,
+                      "stages": {
+                          "knn": {"predicted_bytes": 4 << 28,
+                                  "observed_bytes": 5 << 28,
+                                  "drift": 1.25},
+                          "optimize": {"predicted_bytes": 1 << 28,
+                                       "observed_bytes": 4 << 28,
+                                       "drift": 4.0}}}}
+    msum = memory_summary(rec)
+    mem_ok = (len(msum["rows"]) == 2 and len(msum["warnings"]) == 1
+              and any(r["warn"] and r["stage"] == "optimize"
+                      for r in msum["rows"]))
     ok = (summary["spans"].get("optimize.segment", {}).get("count") == 2
           and "prepare.knn" in summary["spans"]
-          and summary["instants"].get("supervisor.oom") == 1)
+          and summary["instants"].get("supervisor.oom") == 1
+          and mem_ok)
     if out_json:
         print(json.dumps({"ok": ok, "summary": {
             "spans": summary["spans"], "instants": summary["instants"],
-            "segments": summary["segments"]}}))
+            "segments": summary["segments"]}, "memory": msum}))
     else:
         print(render(summary))
+        print()
+        print(render_memory(msum))
         print(f"\nsmoke: {'ok' if ok else 'FAILED'}")
     return 0 if ok else 1
 
@@ -154,11 +262,22 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="self-contained smoke: emit a tiny trace through "
                          "the real tracer and report on it (tier-1)")
+    ap.add_argument("--memory", metavar="RECORD",
+                    help="render the predicted/observed/drift memory "
+                         "table of a bench record JSON (warns on drift "
+                         f"> {DRIFT_WARN}x)")
     args = ap.parse_args(argv)
     if args.smoke:
         return _smoke(args.json)
+    if args.memory:
+        msum = memory_summary(load_record(args.memory))
+        if args.json:
+            print(json.dumps(msum))
+        else:
+            print(render_memory(msum))
+        return 0
     if not args.trace:
-        ap.error("a trace file is required (or --smoke)")
+        ap.error("a trace file is required (or --smoke / --memory)")
     summary = summarize(load_events(args.trace))
     if args.json:
         print(json.dumps(summary))

@@ -1,0 +1,40 @@
+"""carry-hygiene fixture: loop bodies closing over enclosing-scope values.
+
+The two marked loop calls close over enclosing-scope arrays with no
+suppression; the carried-only and rationale'd-suppressed loops at the
+bottom must stay clean (tests/test_lint.py)."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def accumulate(big, scale):
+    def body(i, acc):
+        # closes over `big` and `scale` from the enclosing scope
+        return acc + scale * jnp.sum(big[i])
+
+    return lax.fori_loop(0, 4, body, jnp.zeros(()))  # VIOLATION
+
+
+def scan_lookup(table, xs):
+    def step(carry, x):
+        # closes over `table`
+        return carry + table[x], None
+
+    out, _ = lax.scan(step, jnp.zeros(()), xs)  # VIOLATION
+    return out
+
+
+def clean_carried(xs):
+    def body(i, acc):
+        return acc + i  # nothing closed over beyond the carry
+
+    return lax.fori_loop(0, 4, body, jnp.zeros((), jnp.int32))
+
+
+def suppressed_invariant(big):
+    def body(i, acc):
+        return acc + jnp.sum(big)
+
+    # graftlint: disable=carry-hygiene -- `big` is a loop-invariant
+    # operand; XLA holds one buffer across iterations
+    return lax.fori_loop(0, 4, body, jnp.zeros(()))

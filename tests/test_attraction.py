@@ -1,0 +1,289 @@
+"""graftstep fused-attraction tests (ISSUE 10).
+
+* capped-width CSR build: head/tail partition the valid entries exactly,
+  tail keeps the assemble_edges padding/sorting convention;
+* interpret-mode Pallas parity with the XLA einsum twin on ties-free
+  inputs (forces and loss);
+* kernel + width policies (recorded, env-overridable);
+* the csr layout is numerically interchangeable with rows/edges in the
+  optimizer, and mesh 1 == mesh 4 bit-for-bit on a hub graph whose tail
+  is non-empty;
+* loss gating: the report-slot KL values are identical whether the loss
+  chain runs every iteration (sentinel armed) or only at the interval;
+* repulsion stride: 1 is the default program, >1 stays finite and lands
+  near the exact cadence;
+* (slow) the compiled fused step allocates no [c, S]-scale dense
+  attraction transient — memory_analysis + live-buffer + transfer-guard
+  audit, the r8 drift class pinned at the program level.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.models.tsne import (TsneConfig, init_working_set,
+                                        optimize)
+from tsne_flink_tpu.ops.affinities import (assemble_edges, edge_count,
+                                           joint_distribution,
+                                           pairwise_affinities,
+                                           plan_attraction)
+from tsne_flink_tpu.ops.attraction_pallas import (_run_forces, _run_loss,
+                                                  _xla_forces, _xla_loss,
+                                                  build_csr, csr_tail_pad,
+                                                  pick_attraction_kernel,
+                                                  pick_csr_width)
+from tsne_flink_tpu.ops.knn import knn_bruteforce
+from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+
+pytestmark = pytest.mark.fast
+
+
+def _graph(n=160, k=8, seed=0, hub=True):
+    rng = np.random.default_rng(seed)
+    idx = np.empty((n, k), np.int64)
+    for i in range(n):
+        idx[i] = rng.choice([j for j in range(n) if j != i], k,
+                            replace=False)
+        if hub and i > 0:
+            idx[i, 0] = 0
+    dist = rng.random((n, k)) + 0.05
+    p = pairwise_affinities(jnp.asarray(dist), 5.0)
+    return joint_distribution(jnp.asarray(idx, jnp.int32), p)
+
+
+# ---- CSR build -------------------------------------------------------------
+
+def test_build_csr_partitions_valid_entries_exactly():
+    jidx, jval = _graph(120, 6)
+    ji, jv = np.asarray(jidx), np.asarray(jval)
+    w = 16
+    (hidx, hval), (tsrc, tdst, tval) = build_csr(jidx, jval, w)
+    hidx, hval = np.asarray(hidx), np.asarray(hval)
+    tsrc, tdst, tval = map(np.asarray, (tsrc, tdst, tval))
+    # every row's first min(deg, W) valid entries land in the head, in
+    # row-major order; the rest are the tail, also in row-major order
+    exp = [[(ji[i, s], jv[i, s]) for s in range(ji.shape[1])
+            if jv[i, s] > 0] for i in range(ji.shape[0])]
+    for i, row in enumerate(exp):
+        got = [(hidx[i, c], hval[i, c]) for c in range(w) if hval[i, c] > 0]
+        assert got == row[:w], f"row {i} head"
+    tail_exp = [(i, d, v) for i, row in enumerate(exp)
+                for d, v in row[w:]]
+    nt = len(tail_exp)
+    assert list(zip(tsrc[:nt], tdst[:nt], tval[:nt])) == tail_exp
+    # the padding convention of assemble_edges: ascending src end to end,
+    # val == 0 tail rows on the last row id
+    n = ji.shape[0]
+    assert (tval[nt:] == 0).all() and (tsrc[nt:] == n - 1).all()
+    assert (np.diff(tsrc) >= 0).all()
+    assert len(tsrc) == csr_tail_pad(nt)
+    # head + tail cover the edge multiset exactly
+    assert int((hval > 0).sum()) + nt == int((jv > 0).sum())
+
+
+def test_pick_csr_width_policy_and_override(monkeypatch):
+    # ~1.3x mean degree, 64-rounded, clamped to [64, S]
+    assert pick_csr_width(146 * 60_000, 60_000, 3418) == 192
+    assert pick_csr_width(10 * 1000, 1000, 500) == 64     # floor
+    assert pick_csr_width(400 * 100, 100, 96) == 96       # S clamp
+    monkeypatch.setenv("TSNE_ATTRACTION_WIDTH", "128")
+    assert pick_csr_width(146 * 60_000, 60_000, 3418) == 128
+
+
+def test_plan_attraction_modes():
+    jidx, jval = _graph(160, 6, hub=True)  # hub-widened: csr beneficial
+    layout, w = plan_attraction(jidx, jval, "auto")
+    assert layout == "csr" and 1 <= w <= jidx.shape[1]
+    assert plan_attraction(jidx, jval, "rows") == ("rows", 0)
+    layout, e_pad = plan_attraction(jidx, jval, "edges")
+    assert layout == "edges" and e_pad >= int(jnp.sum(jval > 0))
+    layout, _ = plan_attraction(jidx, jval, "csr")
+    assert layout == "csr"
+    with pytest.raises(ValueError):
+        plan_attraction(jidx, jval, "bogus")
+
+
+# ---- kernel parity + policy ------------------------------------------------
+
+def test_interpret_pallas_matches_xla_twin():
+    """Ties-free inputs: the interpret-mode Pallas head kernels and the
+    XLA einsum twins agree to float noise (forces and loss)."""
+    rng = np.random.default_rng(3)
+    c, w, m = 24, 32, 2
+    yc = jnp.asarray(rng.standard_normal((c, m)), jnp.float32)
+    yj = jnp.asarray(rng.standard_normal((c, w, m)), jnp.float32)
+    val = jnp.asarray(rng.random((c, w)), jnp.float32)
+    val = val.at[:, -5:].set(0.0)  # padding lanes must contribute zero
+    exag = jnp.asarray(4.0, jnp.float32)
+    z = jnp.asarray(37.5, jnp.float32)
+    att_p = _run_forces(yc, yj, val, exag, interpret=True)
+    att_x = _xla_forces(yc, yj, val, exag)
+    np.testing.assert_allclose(np.asarray(att_p), np.asarray(att_x),
+                               rtol=1e-5, atol=1e-6)
+    loss_p = _run_loss(yc, yj, val, exag, z, interpret=True)
+    loss_x = _xla_loss(yc, yj, val, exag, z)
+    np.testing.assert_allclose(np.asarray(loss_p), np.asarray(loss_x),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pick_attraction_kernel_policy(monkeypatch):
+    monkeypatch.delenv("TSNE_ATTRACTION_KERNEL", raising=False)
+    assert pick_attraction_kernel("cpu") == "xla"
+    assert pick_attraction_kernel("tpu") == "pallas"  # foreign: no probe
+    monkeypatch.setenv("TSNE_ATTRACTION_KERNEL", "interpret")
+    assert pick_attraction_kernel("cpu") == "pallas-interpret"
+    monkeypatch.setenv("TSNE_ATTRACTION_KERNEL", "xla")
+    assert pick_attraction_kernel("tpu") == "xla"
+
+
+# ---- optimizer equivalence + mesh bit-identity ------------------------------
+
+def test_optimize_csr_equals_rows_single_device():
+    """One step agrees to summation-order noise; the full run only to a
+    loose tolerance (adaptive-gains chaos, same as the edges test)."""
+    from functools import partial
+    n = 180
+    jidx, jval = _graph(n, 7, seed=1)
+    layout, w = plan_attraction(jidx, jval, "auto")
+    assert layout == "csr"
+    head, tail = build_csr(jidx, jval, w)
+    csr = head + tail
+    cfg = TsneConfig(iterations=30, repulsion="exact", exact_impl="xla")
+    st0 = init_working_set(jax.random.key(3), n, 2, jnp.float64)
+    one = jax.jit(partial(optimize, cfg=cfg, num_iters=1))
+    y1_rows, _ = one(st0, jidx, jval)
+    y1_csr, _ = one(st0, jidx, jval, csr=csr)
+    np.testing.assert_allclose(np.asarray(y1_csr.y), np.asarray(y1_rows.y),
+                               atol=1e-12)
+    run = jax.jit(partial(optimize, cfg=cfg))
+    y_rows, l_rows = run(st0, jidx, jval)
+    y_csr, l_csr = run(st0, jidx, jval, csr=csr)
+    np.testing.assert_allclose(np.asarray(y_csr.y), np.asarray(y_rows.y),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(l_csr), np.asarray(l_rows),
+                               atol=1e-6)
+
+
+def test_mesh_bit_identity_with_csr_tail():
+    """mesh 1 == mesh 4 bit-for-bit through the csr layout on a hub graph
+    with a NON-EMPTY overflow tail (the head-only case degenerates to
+    rows) — the graftstep extension of the test_mesh matrix."""
+    n = 131
+    jidx, jval = _graph(n, 6, seed=2, hub=True)
+    cfg = TsneConfig(iterations=25, repulsion="exact", exact_impl="xla",
+                     attraction="csr", row_chunk=8)
+    st = init_working_set(jax.random.key(0), n, 2, jnp.float64)
+    outs = {}
+    for d in (1, 4):
+        r = ShardedOptimizer(cfg, n, n_devices=d)
+        layout, _, w = r.attraction_plan(jidx, jval)
+        assert layout == "csr"
+        deg = np.count_nonzero(np.asarray(jval) > 0, axis=1)
+        assert int(np.maximum(deg - w, 0).sum()) > 0, "need a real tail"
+        s2, l2 = r(st, jidx, jval)
+        outs[d] = (np.asarray(s2.y), np.asarray(l2))
+    np.testing.assert_array_equal(outs[4][0], outs[1][0])
+    np.testing.assert_array_equal(outs[4][1], outs[1][1])
+
+
+def test_loss_gating_slots_match_sentinel_cadence():
+    """The KL pass is gated to report iterations (lax.cond) unless the
+    sentinel is armed (every iteration).  Both cadences must produce the
+    SAME report-slot values — the gate changes when the loss chain runs,
+    never what it computes."""
+    from functools import partial
+    n = 150
+    jidx, jval = _graph(n, 6, seed=4)
+    cfg = TsneConfig(iterations=20, repulsion="exact", exact_impl="xla")
+    st = init_working_set(jax.random.key(1), n, 2, jnp.float64)
+    run = jax.jit(partial(optimize, cfg=cfg))
+    run_h = jax.jit(partial(optimize, cfg=cfg, with_health=True))
+    _, losses = run(st, jidx, jval)
+    _, losses_h, ok = run_h(st, jidx, jval)
+    assert bool(ok)
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(losses_h),
+                               rtol=1e-6, atol=0)
+
+
+def test_repulsion_stride_optin():
+    from dataclasses import replace
+    from functools import partial
+    n = 150
+    jidx, jval = _graph(n, 6, seed=5)
+    cfg = TsneConfig(iterations=30, repulsion="fft", fft_grid=128)
+    st = init_working_set(jax.random.key(1), n, 2, jnp.float64)
+    y1, l1 = jax.jit(partial(optimize, cfg=cfg))(st, jidx, jval)
+    # stride=1 is the IDENTICAL program (the carry does not exist)
+    y1b, l1b = jax.jit(partial(
+        optimize, cfg=replace(cfg, repulsion_stride=1)))(st, jidx, jval)
+    np.testing.assert_array_equal(np.asarray(y1b.y), np.asarray(y1.y))
+    np.testing.assert_array_equal(np.asarray(l1b), np.asarray(l1))
+    # stride=3: approximate but finite, and not wildly off at this scale
+    y3, l3 = jax.jit(partial(
+        optimize, cfg=replace(cfg, repulsion_stride=3)))(st, jidx, jval)
+    assert np.isfinite(np.asarray(y3.y)).all()
+    assert np.isfinite(np.asarray(l3)).all()
+    assert abs(float(l3[-1]) - float(l1[-1])) < 0.5 * abs(float(l1[-1]))
+
+
+# ---- the step allocates no dense [c, S] attraction transient ---------------
+
+@pytest.mark.slow
+def test_fused_step_has_no_dense_attraction_transient():
+    """Micro-benchmark contract (slow): compile the csr fused step on a
+    hub graph and audit its buffers — the compiled program's TEMP
+    allocation stays far below one dense [c, S] plane (the old
+    metric-path transient) and far below the rows-layout program's
+    temps, no new [c, S]-scale live buffer appears after a step, and
+    the step runs under a disallow transfer guard (no host syncs in the
+    hot path)."""
+    from functools import partial
+    n, k = 4096, 8
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((n, 16)), jnp.float32)
+    idx, dist = knn_bruteforce(x, k)
+    p = pairwise_affinities(dist, 5.0)
+    idx = np.array(idx)  # writable copy
+    idx[1:, 0] = 0  # hub: row 0's symmetrized degree ~ n
+    jidx, jval = joint_distribution(jnp.asarray(idx, jnp.int32), p)
+    s = int(jidx.shape[1])
+    assert s > 40 * k, "hub graph must widen S well past 2k"
+    layout, w = plan_attraction(jidx, jval, "auto")
+    assert layout == "csr" and w < s
+    head, tail = build_csr(jidx, jval, w)
+    csr = head + tail
+    # fft repulsion: its working set is grid-sized, so the step's temps
+    # are dominated by whatever the ATTRACTION materializes
+    cfg = TsneConfig(iterations=1, repulsion="fft", fft_grid=128,
+                     row_chunk=1024)
+    st = init_working_set(jax.random.key(0), n, 2, jnp.float32)
+    step = jax.jit(partial(optimize, cfg=cfg, num_iters=1))
+    compiled = step.lower(st, jidx, jval, csr=csr).compile()
+    ma = compiled.memory_analysis()
+    c = min(cfg.row_chunk, n)
+    dense_plane = c * s * 4  # ONE dense f32 [c, S] attraction plane
+    assert ma.temp_size_in_bytes < 0.5 * dense_plane, (
+        f"fused step temps {ma.temp_size_in_bytes} vs dense [c, S] plane "
+        f"{dense_plane}: a dense attraction transient is back")
+    # differential: the rows-layout program (the dense sweep the csr
+    # replaces) must be the MUCH bigger allocator on the same problem
+    rows_cfg = TsneConfig(iterations=1, repulsion="fft", fft_grid=128,
+                          row_chunk=1024, attraction="rows")
+    rows_ma = jax.jit(partial(optimize, cfg=rows_cfg, num_iters=1)).lower(
+        st, jidx, jval).compile().memory_analysis()
+    assert ma.temp_size_in_bytes < 0.25 * rows_ma.temp_size_in_bytes, (
+        ma.temp_size_in_bytes, rows_ma.temp_size_in_bytes)
+    # live-buffer audit: running the step must not leave any NEW
+    # [c, S]-scale device buffer behind (inputs excluded)
+    before = {id(a) for a in jax.live_arrays()}
+    with jax.transfer_guard("disallow"):
+        out = compiled(st, jidx, jval, csr=csr)
+    jax.block_until_ready(out)
+    grown = [a for a in jax.live_arrays()
+             if id(a) not in before and a.size * a.dtype.itemsize
+             >= dense_plane]
+    assert not grown, [(a.shape, str(a.dtype)) for a in grown]

@@ -118,10 +118,12 @@ def test_fused_pipeline_escalation_uses_edges_and_matches_rows():
                         n_devices=8)
     y_e, l_e = pipe((idx, dist), jax.random.key(7))
     assert pipe._escalations >= 1, "hub graph must overflow the auto width"
-    # the unified optimizer's layout decision: hub-widened rows -> edges
+    # the unified optimizer's layout decision: hub-widened rows -> the
+    # graftstep capped-width CSR (what auto resolves to where the flat
+    # edge list used to win)
     jidx, jval, _ = pipe.prepare((idx, dist), jax.random.key(7))
     layout, _, _ = pipe._runner.attraction_plan(jidx, jval)
-    assert layout == "edges", "hub-widened rows must take the edge layout"
+    assert layout == "csr", "hub-widened rows must take the csr layout"
 
     cfg_r = TsneConfig(iterations=10, repulsion="exact", exact_impl="xla",
                        attraction="rows")

@@ -26,8 +26,12 @@ REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data",
                                      # + host-calibration on EVERY record
             "fleet",  # graftfleet context: None solo, the scheduler's
                       # {name, index, attempt, budget, peak} under a fleet
-            "mesh"}   # graftmesh: the resolved {devices, axis, pad_quantum}
+            "mesh",   # graftmesh: the resolved {devices, axis, pad_quantum}
                       # mesh the optimize loop sharded over
+            "kl",     # graftstep: latest recorded KL on EVERY record
+                      # (None until the first report slot lands)
+            "repulsion_stride"}  # graftstep: the opt-in amortization
+                                 # cadence (1 = exact default)
 
 
 def run_bench(n, iters, extra_env=None, timeout=600):
@@ -71,6 +75,37 @@ def test_every_line_is_a_complete_record():
     assert "partial" not in final and "extrapolated" not in final
     assert final["final_kl"] is not None
     assert final["data"] == "synthetic-blobs"
+    # graftstep: kl rides every record — None before the first report
+    # slot, then the latest recorded value; the final record's kl is the
+    # final KL (and the stride key records the exact default cadence)
+    assert final["kl"] == round(final["final_kl"], 4)
+    assert final["repulsion_stride"] == 1
+    assert any(p["kl"] is not None for p in partials
+               if "optimize" in p.get("stages", {}))
+    assert final["attraction_kernel"] in ("pallas", "pallas-interpret",
+                                          "xla")
+
+
+DRIFT_GATE = 3.0
+COMMITTED_RECORDS = ["bench_60k_fft_cpu_r10_step.json"]
+
+
+@pytest.mark.parametrize("name", COMMITTED_RECORDS)
+def test_committed_record_memory_drift_within_gate(name):
+    """graftstep drift gate: the committed bench record's optimize-stage
+    predicted-vs-observed memory drift must stay <= 3x (the r8 record
+    measured 14.5x against the old model) — a model regression or a new
+    unmodeled allocation fails the bench contract here."""
+    path = os.path.join(REPO, "results", name)
+    with open(path) as f:
+        rec = json.load(f)
+    mem = rec["memory"]
+    st = mem["stages"]["optimize"]
+    assert st["drift"] is not None and st["drift"] <= DRIFT_GATE, st
+    # ... and the graftstep record completeness satellite: kl is a real
+    # number on the committed final record
+    assert isinstance(rec["kl"], float) and rec["kl"] > 0
+    assert rec["kl"] == rec["final_kl"]
 
 
 def test_deadline_stop_leaves_labeled_extrapolation():
@@ -149,11 +184,14 @@ def test_final_record_carries_knn_substages_and_tile_plan():
     subs = final["stages"]["knn_substages"]
     assert subs and all(v >= 0 for v in subs.values())
     # round 7: the auto kNN METHOD routes n=800 on CPU to the exact sweep
-    # (pick_knn_method), recorded as the one "exact" substage
+    # (pick_knn_method); graftstep decomposes it into the setup/sweep/
+    # top-k substages so exact and hybrid records are comparable in
+    # scripts/trace_report.py
     assert final["knn_method"] == "bruteforce"
-    assert "exact" in subs
+    assert {"exact_setup", "exact_sweep", "exact_topk"} <= set(subs)
+    assert subs["exact_sweep"] > 0
     fsub = final["stage_flops"]["knn_substages"]
-    assert fsub["exact"] > 0  # cold run: substage FLOPs are real
+    assert fsub["exact_sweep"] > 0  # cold run: substage FLOPs are real
     # round 7: compile split + AOT cache label ride every record
     assert final["aot_cache"] in ("off", "cold", "warm", "mixed")
     assert "knn" in final["compile_seconds"]

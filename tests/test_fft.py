@@ -68,16 +68,23 @@ def test_fft_3d_error_vs_grid_and_span():
 
 
 def test_fft_sharded_rows_match_full():
+    """Sharded force rows concatenate to the full result; Z is the
+    graftstep SPECTRAL sum — a GLOBAL, replicated scalar built from the
+    full (all-gathered) point set, so every shard returns the SAME bits
+    as the full call (mesh-canonical by construction; no psum)."""
     y = embedding(128, 2, seed=3)
     rep_full, z_full = fft_repulsion(y, grid=256)
-    reps, zs = [], 0.0
+    reps = []
     for off in range(0, 128, 32):
         r, z = fft_repulsion(y[off:off + 32], y, grid=256, row_offset=off)
         reps.append(np.asarray(r))
-        zs += float(z)
+        assert float(z) == float(z_full), "spectral Z must be replicated"
     np.testing.assert_allclose(np.concatenate(reps), np.asarray(rep_full),
                                rtol=1e-9, atol=1e-12)
-    np.testing.assert_allclose(zs, float(z_full), rtol=1e-9)
+    # ... and the spectral Z equals the summed per-point potentials the
+    # old gather form computed (same interpolation, Parseval identity)
+    rep_e, z_e = exact_repulsion(y)
+    assert abs(float(z_full) - float(z_e)) / float(z_e) < 5e-3
 
 
 def test_fft_col_valid_excludes_padding():

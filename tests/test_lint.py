@@ -61,7 +61,8 @@ def test_all_rules_registered():
                           "dtype-drift", "bench-record-contract",
                           "cli-api-parity", "audit-contract",
                           "exception-hygiene", "timing-hygiene",
-                          "resource-hygiene", "mesh-hygiene"}
+                          "resource-hygiene", "mesh-hygiene",
+                          "carry-hygiene"}
 
 
 # ---- every fixture violation is found, suppressions silence ---------------
@@ -79,6 +80,7 @@ FIXTURE_FOR_RULE = {
                                    "fx_timing_hygiene.py"),
     "resource-hygiene": os.path.join("runtime", "fx_resource_hygiene.py"),
     "mesh-hygiene": os.path.join("tsne_flink_tpu", "fx_mesh_hygiene.py"),
+    "carry-hygiene": os.path.join("models", "fx_carry_hygiene.py"),
 }
 
 

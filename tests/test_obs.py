@@ -348,6 +348,31 @@ def test_trace_report_smoke_subprocess():
     payload = json.loads(r.stdout)
     assert payload["ok"] is True
     assert payload["summary"]["spans"]["optimize.segment"]["count"] == 2
+    # graftstep satellite: the --memory table path is smoke-covered too —
+    # a >3x drift stage must surface as a warning
+    mem = payload["memory"]
+    assert {r_["stage"] for r_ in mem["rows"]} == {"knn", "optimize"}
+    assert len(mem["warnings"]) == 1 and "optimize" in mem["warnings"][0]
+
+
+def test_trace_report_memory_table_on_record(tmp_path):
+    """--memory renders a committed-record-shaped memory block and flags
+    drift > 3x (the r8 optimize drift class)."""
+    rec = {"memory": {"basis": "rss", "predicted_peak": 100,
+                      "observed_peak": 150, "drift": 1.5,
+                      "stages": {"optimize": {"predicted_bytes": 10,
+                                              "observed_bytes": 140,
+                                              "drift": 14.0}}}}
+    p = tmp_path / "rec.json"
+    p.write_text(json.dumps(rec))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         "--memory", str(p), "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["rows"][0]["warn"] is True
+    assert payload["warnings"] and "14.0x" in payload["warnings"][0]
 
 
 def test_trace_report_on_real_trace(tmp_path):

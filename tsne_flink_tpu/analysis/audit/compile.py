@@ -69,11 +69,13 @@ def segment_keys(iterations: int, checkpoint_every: int = 0,
 
 def knn_stage_programs(plan: PlanConfig) -> int:
     """Compiled executables the prepare stage's kNN dispatch launches
-    (utils/artifacts.prepare runs the hybrid DECOMPOSED): seed + cycle +
+    (utils/artifacts.prepare runs BOTH plans DECOMPOSED): seed + cycle +
     merge + refine for the refined hybrid — constant in the cycle count —
-    else the one fused program."""
+    and setup + sweep + final-top-k for the exact methods (graftstep:
+    ops/knn._knn_exact_staged, the substage-attributed form the bench
+    records)."""
     if plan.resolved_method() != "project":
-        return 1  # one fused exact program (XLA tiles or the Pallas sweep)
+        return 3  # exact_setup + exact_sweep + exact_topk
     _rounds, refine = plan.resolved_knn()
     return 4 if refine > 0 else 1
 

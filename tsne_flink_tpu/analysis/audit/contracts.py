@@ -254,6 +254,79 @@ contract("ops.knn_pallas._run_cand",
          "tsne_flink_tpu/ops/knn_pallas.py", ("float32",),
          trace=False)
 
+# graftstep: the decomposed exact-sweep stages (ops/knn._knn_exact_staged
+# jits them per stage so the bench can attribute setup/sweep/top-k).
+def _mk_bf_setup():
+    from tsne_flink_tpu.ops.knn import _bf_setup
+    return lambda x: _bf_setup(x, 64), (_f32(N, D),)
+
+
+def _mk_bf_sweep():
+    from tsne_flink_tpu.ops.knn import _bf_setup, _bf_sweep
+    return (lambda x: _bf_sweep(*_bf_setup(x, 64), x, K, "sqeuclidean"),
+            (_f32(N, D),))
+
+
+def _mk_part_setup():
+    from tsne_flink_tpu.ops.knn import _part_setup
+    return lambda x: _part_setup(x, 64, 4), (_f32(N, D),)
+
+
+def _mk_part_sweep():
+    from tsne_flink_tpu.ops.knn import _part_setup, _part_sweep
+    return (lambda x: _part_sweep(*_part_setup(x, 64, 4), N, K,
+                                  "sqeuclidean"), (_f32(N, D),))
+
+
+def _mk_exact_final():
+    from tsne_flink_tpu.ops.knn import _exact_final
+    return (lambda d, i: _exact_final(d, i, N, K),
+            (_f32(N, K), _i32(N, K)))
+
+
+contract("ops.knn._bf_setup", "tsne_flink_tpu/ops/knn.py",
+         ("float32", "int32"), _mk_bf_setup)
+contract("ops.knn._bf_sweep", "tsne_flink_tpu/ops/knn.py",
+         ("float32", "int32"), _mk_bf_sweep, matmul_dim=D)
+contract("ops.knn._part_setup", "tsne_flink_tpu/ops/knn.py",
+         ("float32", "int32", "float32", "int32"), _mk_part_setup)
+contract("ops.knn._part_sweep", "tsne_flink_tpu/ops/knn.py",
+         ("float32", "int32"), _mk_part_sweep, matmul_dim=D)
+contract("ops.knn._exact_final", "tsne_flink_tpu/ops/knn.py",
+         ("int32", "float32"), _mk_exact_final)
+
+
+def _mk_fused_prep():
+    from tsne_flink_tpu.ops.knn_pallas import _fused_prep
+    return lambda x: _fused_prep(x, "sqeuclidean"), (_f32(N, D),)
+
+
+def _mk_fused_final():
+    from tsne_flink_tpu.ops.knn_pallas import _fused_final, kpad_for
+    return (lambda d, i: _fused_final(d, i, n=N, k=K),
+            (_f32(N, kpad_for(K)), _i32(N, kpad_for(K))))
+
+
+contract("ops.knn_pallas._fused_prep", "tsne_flink_tpu/ops/knn_pallas.py",
+         ("float32", "float32", "int32"), _mk_fused_prep)
+# the Mosaic sweep stage: declared-only like _run_fused (runtime-probed)
+contract("ops.knn_pallas._fused_sweep", "tsne_flink_tpu/ops/knn_pallas.py",
+         ("float32", "int32"), trace=False)
+contract("ops.knn_pallas._fused_final", "tsne_flink_tpu/ops/knn_pallas.py",
+         ("int32", "float32"), _mk_fused_final)
+
+
+# ---- ops/attraction_pallas.py ----------------------------------------------
+# graftstep fused attraction head kernels: declared-only like the other
+# Mosaic kernels (runtime-probed by mosaic_attraction_supported; the XLA
+# einsum twins inside models.tsne.optimize carry the traced contract).
+contract("ops.attraction_pallas._run_forces",
+         "tsne_flink_tpu/ops/attraction_pallas.py", ("float32",),
+         trace=False)
+contract("ops.attraction_pallas._run_loss",
+         "tsne_flink_tpu/ops/attraction_pallas.py", ("float32",),
+         trace=False)
+
 
 # ---- models/tsne.py ---------------------------------------------------------
 

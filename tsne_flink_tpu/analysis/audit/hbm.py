@@ -159,16 +159,34 @@ def _affinity_stage(plan: PlanConfig) -> dict:
 
 def _optimize_stage(plan: PlanConfig) -> dict:
     """The compiled loop's PER-DEVICE resident set + its dominant
-    per-iteration transients.  graftmesh: the optimize loop is
-    point-sharded over ``plan.mesh`` devices, so every row-sharded term
-    (working set, P rows/edges, attraction sweep, per-row repulsion
-    tiles) is accounted at ``n_local = ceil(n / mesh)`` rows — the
-    gathered ``[N, m]`` embedding, the full-N distance-tile columns, and
-    the replicated FFT grid stay at N on every device.  mesh=1 reproduces
-    the old single-chip model exactly."""
+    per-iteration transients — reworked by graftstep to count the REAL
+    live set (the r8 record observed a 14.5x drift under the old model,
+    which ignored the resident prepare artifacts, the measured hub
+    width, and the FFT working set):
+
+    * graftmesh: row-sharded terms (working set, P rows, CSR head,
+      attraction tiles) are accounted at ``n_local = ceil(n / mesh)``
+      rows; the gathered ``[N, m]`` embedding, the full-N distance-tile
+      columns and the replicated FFT arrays stay at N on every device.
+      On the CPU backend the mesh is VIRTUAL (one process, one RSS
+      watermark): every row-sharded term is accounted at full N and the
+      caller-held input + kNN graph join the live set (``resident``) —
+      that is what the recorded ``basis: rss`` watermark actually sees.
+    * attraction mirrors ``plan_attraction``: the capped-width CSR (head
+      ``[nl, W]`` arrays + overflow tail + the per-chunk gather tile),
+      the flat edge list (explicit), the split-blocks pair, or the
+      chunked rows sweep — the source ``[nl, s]`` P rows stay live in
+      every layout (they are operands of the compiled segment).
+    * repulsion fft counts the graftstep program: the hoisted lattice,
+      kernel tables, one padded grid + its rfft, the kernel-pair rfft,
+      and ONE inverse volume (spectral Z needs no inverse) plus the
+      single-scatter spread operands.
+    * the loss/telemetry carries and the opt-in stride carry are listed
+      (small, but they are the buffers the segment donates)."""
     n, k, m, isz = plan.n, plan.k, plan.n_components, plan.itemsize
     mesh = max(1, int(plan.mesh))
-    nl = -(-n // mesh)                        # per-device local rows
+    cpu = plan.backend == "cpu"
+    nl = n if cpu else -(-n // mesh)          # per-device local rows
     s = plan.sym_width_est()
     label = plan.resolved_assembly()
     rep = plan.resolved_repulsion()
@@ -176,43 +194,71 @@ def _optimize_stage(plan: PlanConfig) -> dict:
     # non-strings as byte counts (GiB-rounded)
     terms: dict[str, float] = {"repulsion": rep, "assembly": label,
                                "mesh": str(mesh)}
+    # caller-held inputs on the RSS basis: the CLI/bench/estimator keep x
+    # and the kNN graph alive through optimize in the same process
+    resident = float(n * plan.d * isz + n * k * (4 + isz)) if cpu else 0.0
+    terms["resident"] = resident
     state = 2.0 * 3.0 * nl * m * isz          # (y, update, gains), updated
     y_full = float(n * m * isz)               # gathered embedding: full N
     terms["state"] = state + y_full
+    c = min(plan.row_chunk, nl)
+    e_est = 2.0 * n * k                       # true-edge upper bound
+    from tsne_flink_tpu.ops.affinities import edges_beneficial
     if label == "blocks":
         p_arrays = nl * k * (4.0 + isz) + nl * k * (8.0 + isz)
-        e_attr = nl * k                       # per-shard reverse block edges
-        attr = e_attr * (2.0 * m * isz + 4.0 * isz)
+        # forward block: chunked width-k rows sweep; reverse block: edges
+        attr = (PIPELINE_FACTOR * c * k * (m * isz + 3.0 * isz)
+                + nl * k * (2.0 * m * isz + 4.0 * isz))
+    elif plan.attraction == "edges":
+        p_arrays = float(nl * s * (4 + isz)) + (e_est / mesh) * (8.0 + isz)
+        attr = (e_est / mesh) * (2.0 * m * isz + 4.0 * isz)
+    elif plan.attraction in ("auto", "csr") and (
+            plan.attraction == "csr" or edges_beneficial(e_est, n, s)):
+        # graftstep capped-width CSR: the [nl, s] source rows stay live
+        # (segment operands) + head/tail arrays + the per-chunk tile set
+        from tsne_flink_tpu.ops.attraction_pallas import pick_csr_width
+        w = pick_csr_width(int(e_est), n, s)
+        tail = max(0.0, e_est - 0.85 * n * min(w, 2 * k)) / mesh
+        p_arrays = (float(nl * s * (4 + isz))          # source P rows
+                    + nl * w * (4.0 + isz)             # head idx/val
+                    + tail * (8.0 + isz))              # overflow tail
+        attr = (PIPELINE_FACTOR * c * w * (m * isz + 4.0 * isz)
+                + tail * (2.0 * m * isz + 4.0 * isz))
     else:
         p_arrays = float(nl * s * (4 + isz))
-        # layout decision mirrors plan_edges' gate with the ~2Nk true-edge
-        # upper bound: hub-widened rows route to the flat edge layout
-        e_est = 2.0 * n * k
-        from tsne_flink_tpu.ops.affinities import edges_beneficial
-        if plan.attraction == "edges" or (
-                plan.attraction == "auto" and edges_beneficial(e_est, n, s)):
-            attr = (e_est / mesh) * (3.0 * 4.0 + 2.0 * m * isz + 2.0 * isz)
-        else:
-            c = min(plan.row_chunk, nl)
-            attr = PIPELINE_FACTOR * c * s * (m * isz + isz + 4.0)
+        attr = PIPELINE_FACTOR * c * s * (m * isz + 4.0 * isz)
     terms["p_arrays"] = p_arrays
     terms["attraction"] = attr
     if rep == "exact":
-        c = min(plan.row_chunk, nl)
         terms["repulsion_tile"] = PIPELINE_FACTOR * c * n * isz
     elif rep == "bh":
         from tsne_flink_tpu.ops.repulsion_bh import (default_frontier,
                                                      default_levels)
         lv = default_levels(n, m)
         fr = default_frontier(n, m, lv, plan.theta)
-        c = min(plan.row_chunk, nl)
         terms["repulsion_tile"] = c * fr * 3.0 * isz + n * lv * 4.0
-    else:  # fft
+    else:  # fft — the graftstep program (repulsion_fft module docstring)
         from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
         g = DEFAULT_GRID.get(m, 1024)
-        terms["repulsion_tile"] = float((2 * g) ** m * (1 + m + 2) * 2 * isz)
-    terms["peak"] = (terms["state"] + p_arrays + attr
-                     + terms["repulsion_tile"])
+        nch = 1 + m
+        big = float((2 * g) ** m)              # circulant volume (cells)
+        half = big / (2 * g) * (g + 1)         # rfft half-spectrum (cells)
+        taps = 3 ** m                          # interp-order stencil
+        terms["repulsion_tile"] = (
+            big * isz                          # hoisted rho2 lattice
+            + 2.0 * big * isz                  # k1/k2 tables
+            + 2.0 * half * 2 * isz             # their rfft pair
+            + float(g ** m) * nch * isz        # spread grid
+            + taps * n * (nch + 1.0) * isz     # one-scatter spread operands
+            + big * nch * isz                  # padded grid
+            + half * nch * 2 * isz             # its rfft
+            + big * nch * isz)                 # ONE inverse volume
+    # the segment's carried scalars/traces: loss + telemetry slots, and
+    # the opt-in stride's (rep, Z) carry
+    slots = max(1, plan.iterations // 10)
+    terms["carries"] = float(slots * 6 * isz + nl * m * isz)
+    terms["peak"] = (resident + terms["state"] + p_arrays + attr
+                     + terms["repulsion_tile"] + terms["carries"])
     return terms
 
 

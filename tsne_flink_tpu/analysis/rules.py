@@ -44,6 +44,12 @@ once (ADVICE/VERDICT rounds 1-5); the linter catches it forever:
   are made (``AXIS``, ``pspec``/``rspec``/``state_pspec``, ``MeshPlan``)
   — a drifted literal or a second spec factory is how the two-pipeline
   seam grew the first time.
+* ``carry-hygiene``     — ``fori_loop``/``scan`` bodies in ``models/``
+  and ``parallel/`` that close over enclosing-scope values: mutated
+  state belongs in the carry (donated at the jit boundary), and a
+  loop-invariant operand closure must say so in a rationale'd
+  suppression at the loop call (graftstep: the r8 memory drift came
+  from exactly this class of unexamined per-iteration allocations).
 
 Rules are pure-AST project passes registered with :func:`core.rule`; they
 never import the code under analysis.
@@ -1125,4 +1131,158 @@ def timing_hygiene(project: Project):
                 "`with trace.span(...) as sp:` then sp.seconds) so the "
                 "measurement lands in the trace/metrics schema; suppress "
                 "with the rationale if a raw clock is genuinely required"))
+    return findings
+
+
+# ---- rule: carry-hygiene ---------------------------------------------------
+
+_LOOP_ATTRS = ("fori_loop", "scan")
+
+
+def _module_scope_names(tree: ast.Module) -> set[str]:
+    """Names bound at module level: imports, defs, classes, assignments."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+def _bound_in_subtree(fn: ast.AST) -> set[str]:
+    """Every name the function subtree binds: params (its own and nested
+    defs'/lambdas'), assignment/loop/with/comprehension targets, nested
+    def names.  An over-approximation of 'local' — exactly right for a
+    closure check (anything bound anywhere inside is not free)."""
+    bound: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                bound.add(arg.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+        elif isinstance(node, ast.Lambda):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                bound.add(arg.arg)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                       (ast.Store,
+                                                        ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+    return bound
+
+
+def _loop_body_arg(node: ast.Call, attr: str):
+    """The body-function argument of a fori_loop/scan call."""
+    if attr == "fori_loop":
+        if len(node.args) >= 3:
+            return node.args[2]
+        for kw in node.keywords:
+            if kw.arg == "body_fun":
+                return kw.value
+    else:  # scan
+        if node.args:
+            return node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "f":
+                return kw.value
+    return None
+
+
+def _resolve_local_def(mod_tree: ast.AST, name: str,
+                       call: ast.Call) -> ast.FunctionDef | None:
+    """The nearest FunctionDef named ``name`` defined before the call."""
+    best = None
+    for node in ast.walk(mod_tree):
+        if (isinstance(node, ast.FunctionDef) and node.name == name
+                and node.lineno <= call.lineno):
+            if best is None or node.lineno > best.lineno:
+                best = node
+    return best
+
+
+@rule("carry-hygiene",
+      "fori_loop/scan bodies in models/ and parallel/ that close over "
+      "enclosing-scope values — loop state must be carried/donated, and "
+      "loop-invariant operand closures need a rationale'd suppression")
+def carry_hygiene(project: Project):
+    """graftstep: a ``fori_loop``/``scan`` body that closes over an
+    enclosing-scope array BIGGER than its carry is either (a) loop state
+    that should be carried (and donated at the jit boundary) or (b) a
+    loop-invariant operand that XLA hoists — but the reader cannot tell
+    which, and (a) silently re-materializes per iteration.  The rule
+    flags every closure (a pure-AST pass cannot size arrays) and the
+    legitimate loop-invariant-operand cases carry a rationale'd
+    suppression at the loop call — so every closure in the optimize hot
+    path is an audited, explained decision."""
+    findings = []
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        if not ("models/" in norm or "parallel/" in norm):
+            continue
+        lax_mods = _import_aliases(mod.tree, "jax.lax") | {"lax"}
+        from_names = set()
+        for attr in _LOOP_ATTRS:
+            from_names |= _from_import_aliases(mod.tree, attr)
+        scope = _module_scope_names(mod.tree)
+        import builtins
+        scope |= set(dir(builtins))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = None
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _LOOP_ATTRS):
+                attr = func.attr
+            elif isinstance(func, ast.Name) and func.id in from_names:
+                attr = ("fori_loop" if func.id.endswith("fori_loop")
+                        else "scan")
+            if attr is None:
+                continue
+            body = _loop_body_arg(node, attr)
+            if body is None:
+                continue
+            if isinstance(body, ast.Name):
+                body_fn = _resolve_local_def(mod.tree, body.id, node)
+            elif isinstance(body, (ast.Lambda, ast.FunctionDef)):
+                body_fn = body
+            else:
+                body_fn = None
+            if body_fn is None:
+                continue
+            bound = _bound_in_subtree(body_fn) | scope
+            free = sorted({
+                sub.id for sub in ast.walk(body_fn)
+                if isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id not in bound})
+            if free:
+                findings.append(mod.finding(
+                    "carry-hygiene", node,
+                    f"{attr} body closes over enclosing-scope names "
+                    f"{free}: loop state must ride the carry (and be "
+                    "donated at the jit boundary); a loop-INVARIANT "
+                    "operand closure is fine but must say so in a "
+                    "rationale'd suppression at this call"))
     return findings

@@ -100,9 +100,10 @@ class TSNE:
         if bh_gate not in ("vdm", "flink"):
             raise ValueError(f"bh_gate '{bh_gate}' not defined (vdm | flink)")
         self.bh_gate = bh_gate
-        # attraction-sweep layout — see ops/affinities.plan_edges; auto picks
-        # the flat edge layout on hub-heavy graphs.  Validated HERE so a typo
-        # fails at construction, not after the multi-minute kNN stage
+        # attraction-sweep layout — see ops/affinities.plan_attraction;
+        # auto picks the graftstep capped-width CSR on hub-heavy graphs.
+        # Validated HERE so a typo fails at construction, not after the
+        # multi-minute kNN stage
         from tsne_flink_tpu.models.tsne import REPULSION_CHOICES
         from tsne_flink_tpu.ops.affinities import ATTRACTION_MODES
         if attraction not in ATTRACTION_MODES:
@@ -164,6 +165,7 @@ class TSNE:
 
     def _config(self, n: int) -> TsneConfig:
         from tsne_flink_tpu.utils.cli import pick_repulsion
+        from tsne_flink_tpu.utils.env import env_int as _env_int
 
         return TsneConfig(
             n_components=self.n_components, perplexity=self.perplexity,
@@ -175,7 +177,10 @@ class TSNE:
             repulsion=pick_repulsion(self.repulsion, self.theta, n,
                                      self.n_components,
                                      self.theta_explicit_),
-            attraction=self.attraction, bh_gate=self.bh_gate)
+            attraction=self.attraction, bh_gate=self.bh_gate,
+            # graftstep env-only knob (no estimator kwarg on purpose:
+            # stride > 1 is an approximation, opted into per environment)
+            repulsion_stride=_env_int("TSNE_REPULSION_STRIDE"))
 
     def fit(self, x, y=None) -> "TSNE":
         import jax

@@ -26,7 +26,6 @@ With ``axis_name=None`` the same code runs single-device with zero overhead.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from functools import partial
 from typing import NamedTuple
@@ -68,10 +67,13 @@ class TsneConfig:
     min_gain: float = 0.01  # TsneHelpers.scala:386
     repulsion: str = "exact"  # exact | bh | fft
     exact_impl: str = "auto"  # auto | xla | pallas (auto: pallas on TPU f32)
-    attraction: str = "auto"  # auto | rows | edges (auto: edges when the true
-    # edge count is well under N x sym_width — hub-heavy graphs; see
-    # ops/affinities.assemble_edges)
+    attraction: str = "auto"  # auto | rows | edges | csr (auto: the capped-
+    # width CSR layout when the true edge count is well under N x sym_width
+    # — hub-heavy graphs; see ops/affinities.plan_attraction)
     row_chunk: int = 2048
+    repulsion_stride: int = 1  # graftstep opt-in (TSNE_REPULSION_STRIDE):
+    # recompute repulsion every Nth iteration, carrying (rep, Z) between —
+    # 1 (default) is the exact, bit-identical every-iteration cadence
     bh_levels: int | None = None   # None: auto depth (repulsion_bh.py)
     bh_frontier: int | None = None  # None: auto width, depth/theta-scaled
     # (repulsion_bh.default_frontier — VERDICT r3 weak #4)
@@ -171,108 +173,72 @@ def _telemetry_row(st: "TsneState", grad, axis_name, valid):
                       ymax]).astype(dt)
 
 
-def _attractive_forces(y_local, y_full, jidx, jval, exag, z,
-                       row_chunk=4096, row_loss=False):
-    """F_attr_i = Σ_j P_ij q_ij (y_i − y_j) with the Student-t kernel
-    q = 1/(1 + ‖y_i − y_j‖²) (TsneHelpers.scala:284-305), plus the partial
-    KL loss Σ p log(p/(q/Z)) (:297-300).  Row-chunked so the [c, S, m]
-    gather stays in VMEM-friendly tiles.
+def _edge_forces(y_local, y_full, src, dst, val, exag):
+    """Edge-layout attraction forces, summed per-edge with a sorted
+    ``segment_sum`` — work scales with the TRUE edge count, not
+    N x max hub degree (see ``ops/affinities.assemble_edges``).  ``src``
+    holds LOCAL row indices of this shard; ``dst`` indexes the gathered
+    global embedding.  The sequential per-row scatter semantics are what
+    keeps the sum mesh-width-stable (graftmesh).
 
-    DELIBERATE fix vs the reference: the embedding-space kernel is ALWAYS
-    squared-euclidean Student-t — the low-dim similarity t-SNE is defined
-    on — while ``--metric`` applies to the high-dim kNN/affinity stage
-    only.  The reference reuses the input metric here
-    (TsneHelpers.scala:293) but its repulsion stays euclidean
-    (QuadTree.scala:133-141); with ``--metric cosine`` that q does not
-    decay with radius, the force balance breaks, and the embedding
-    diverges to overflow (reproduced: 120-point blobs, NaN by iteration
-    ~40)."""
-    nloc, m = y_local.shape
-    s = jidx.shape[1]
-    f = metric_fn("sqeuclidean")
-    c = min(row_chunk, nloc)
-    nchunks = math.ceil(nloc / c)
-    pad = nchunks * c - nloc
-    yp = jnp.pad(y_local, ((0, pad), (0, 0)))
-    ip = jnp.pad(jidx, ((0, pad), (0, 0)))
-    vp = jnp.pad(jval, ((0, pad), (0, 0)))
-
-    def one_chunk(args):
-        yc, ic, vc = args
-        yj = y_full[ic]                      # [c, S, m]
-        q = 1.0 / (1.0 + f(yc[:, None, :], yj))
-        pe = vc * exag
-        w = pe * q
-        att = yc * jnp.sum(w, axis=1)[:, None] - jnp.einsum("cs,csm->cm", w, yj)
-        mask = vc > 0
-        pe_safe = jnp.where(mask, pe, 1.0)
-        q_safe = jnp.where(mask, q, 1.0)
-        terms = jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0)
-        # row_loss (static): per-row partial KL — the mesh-canonical form
-        # the sharded optimizer reduces via _mesh_sum (graftmesh); False
-        # keeps the scalar path byte-identical to the pre-graftmesh code
-        return att, (jnp.sum(terms, axis=1) if row_loss
-                     else jnp.sum(terms))
-
-    att, loss = lax.map(one_chunk, (yp.reshape(nchunks, c, m),
-                                    ip.reshape(nchunks, c, s),
-                                    vp.reshape(nchunks, c, s)))
-    if row_loss:
-        return att.reshape(-1, m)[:nloc], loss.reshape(-1)[:nloc]
-    return att.reshape(-1, m)[:nloc], jnp.sum(loss)
-
-
-def _attractive_forces_edges(y_local, y_full, src, dst, val, exag, z,
-                             row_loss=False):
-    """Edge-layout attraction: identical math to :func:`_attractive_forces`
-    (including the always-sqeuclidean Student-t kernel — see its docstring)
-    but summed per-edge with a sorted ``segment_sum`` instead of per padded
-    row slot — work scales with the TRUE edge count, not N x max hub degree
-    (see :func:`tsne_flink_tpu.ops.affinities.assemble_edges`).  ``src`` holds
-    LOCAL row indices of this shard; ``dst`` indexes the gathered global
-    embedding."""
+    DELIBERATE fix vs the reference (here and in every attraction form):
+    the embedding-space kernel is ALWAYS squared-euclidean Student-t —
+    the low-dim similarity t-SNE is defined on — while ``--metric``
+    applies to the high-dim kNN/affinity stage only.  The reference
+    reuses the input metric here (TsneHelpers.scala:293) but its
+    repulsion stays euclidean (QuadTree.scala:133-141); with ``--metric
+    cosine`` that q does not decay with radius, the force balance breaks,
+    and the embedding diverges to overflow (reproduced: 120-point blobs,
+    NaN by iteration ~40)."""
     f = metric_fn("sqeuclidean")
     yi = y_local[src]                     # [E, m]
     yj = y_full[dst]                      # [E, m]
     q = 1.0 / (1.0 + f(yi, yj))           # [E]
+    w = val * exag * q
+    return jax.ops.segment_sum(w[:, None] * (yi - yj), src,
+                               num_segments=y_local.shape[0],
+                               indices_are_sorted=True)
+
+
+def _edge_loss(y_local, y_full, src, dst, val, exag, z):
+    """Per-row partial KL of an edge block (zero padding edges land on the
+    last local row and add exactly 0.0) — the mesh-canonical per-row form
+    :func:`_mesh_sum` reduces."""
+    f = metric_fn("sqeuclidean")
+    yi = y_local[src]
+    yj = y_full[dst]
+    q = 1.0 / (1.0 + f(yi, yj))
     pe = val * exag
-    w = pe * q
-    att = jax.ops.segment_sum(w[:, None] * (yi - yj), src,
-                              num_segments=y_local.shape[0],
-                              indices_are_sorted=True)
     mask = val > 0
     pe_safe = jnp.where(mask, pe, 1.0)
     q_safe = jnp.where(mask, q, 1.0)
     terms = jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0)
-    if row_loss:
-        # per-row partial KL via the same sorted segment reduction as the
-        # forces — mesh-canonical (the zero padding edges land on the last
-        # local row and add exactly 0.0)
-        loss = jax.ops.segment_sum(terms, src,
-                                   num_segments=y_local.shape[0],
-                                   indices_are_sorted=True)
-    else:
-        loss = jnp.sum(terms)
-    return att, loss
+    return jax.ops.segment_sum(terms, src,
+                               num_segments=y_local.shape[0],
+                               indices_are_sorted=True)
 
 
-def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
-              axis_name=None, row_offset=0, valid_full=None, edges=None,
-              edges_extra=False):
-    """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317).
+def _repulsion_scratch(cfg: TsneConfig, m: int, dtype):
+    """Loop-invariant repulsion scratch, built ONCE before the optimize
+    ``fori_loop`` (graftstep): the FFT backend's circulant lattice
+    (``ops/repulsion_fft.fft_geometry``).  The exact/pallas/bh kernels'
+    per-iteration scratch is [N]-scale index/weight arithmetic that XLA's
+    loop-invariant code motion already hoists — nothing to carry."""
+    if cfg.repulsion == "fft":
+        from tsne_flink_tpu.ops.repulsion_fft import fft_geometry
+        return fft_geometry(m, cfg.fft_grid, dtype)
+    return None
 
-    ``valid_full`` is the GLOBAL point-validity mask (already gathered once,
-    outside the iteration loop — it is loop-invariant).
 
-    Under a mesh (``axis_name`` given) the Z and KL reductions are
-    mesh-canonical (graftmesh): the kernels return PER-ROW partials
-    (``row_z``/``row_loss``) and :func:`_mesh_sum` reduces the gathered
-    ``[N_padded]`` vector in one fixed order, so every mesh width sharing
-    the padding quantum reproduces the same bits.  ``axis_name=None``
-    keeps the original scalar reductions byte-for-byte."""
+def _repulsion(y_local, y_full, cfg: TsneConfig, axis_name, row_offset,
+               valid_full, rep_scratch=None):
+    """(rep [nloc, m], Z) for the configured backend; Z is already the
+    GLOBAL partition sum.  Under a mesh the exact/bh/pallas kernels
+    return PER-ROW partials (``row_z``) reduced mesh-canonically by
+    :func:`_mesh_sum`; the FFT backend's spectral Z is replicated and
+    fixed-order by construction (ops/repulsion_fft docstring) and is used
+    directly — no collective."""
     row_r = axis_name is not None
-    y_full = (y_local if axis_name is None
-              else lax.all_gather(y_local, axis_name, tiled=True))
     if cfg.repulsion == "exact":
         impl = cfg.exact_impl
         if impl == "auto":
@@ -300,30 +266,122 @@ def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
                                col_valid=valid_full, row_chunk=cfg.row_chunk,
                                row_z=row_r)
     elif cfg.repulsion == "fft":
-        rep, sq = fft_repulsion(y_local, y_full, grid=cfg.fft_grid,
-                                interp=cfg.fft_interp, row_offset=row_offset,
-                                col_valid=valid_full, row_z=row_r)
+        rep, z = fft_repulsion(y_local, y_full, grid=cfg.fft_grid,
+                               interp=cfg.fft_interp, row_offset=row_offset,
+                               col_valid=valid_full, geom=rep_scratch)
+        return rep, z  # spectral Z: global + replicated already
     else:
         raise ValueError(f"unknown repulsion backend '{cfg.repulsion}'")
-    z = _mesh_sum(sq, axis_name) if row_r else _psum(sq, axis_name)
-    if edges is not None and edges_extra:
+    return rep, (_mesh_sum(sq, axis_name) if row_r
+                 else _psum(sq, axis_name))
+
+
+def _att_kernel() -> str:
+    """The resolved attraction kernel for this trace — a static policy
+    read (``ops/attraction_pallas.pick_attraction_kernel``)."""
+    from tsne_flink_tpu.ops.attraction_pallas import pick_attraction_kernel
+    return pick_attraction_kernel()
+
+
+def _attraction_forces(y_local, y_full, jidx, jval, cfg: TsneConfig, exag,
+                       edges=None, edges_extra=False, csr=None):
+    """F_attr_i = Σ_j P_ij q_ij (y_i − y_j) (TsneHelpers.scala:284-305)
+    over whichever layout is armed: the capped-width CSR (head rows
+    through the fused kernel + flat overflow tail — graftstep), the flat
+    edge list, the split-blocks pair, or the padded [N, S] rows."""
+    from tsne_flink_tpu.ops.attraction_pallas import attraction_forces
+    kern = _att_kernel()
+    if csr is not None:
+        hidx, hval, tsrc, tdst, tval = csr
+        att = (attraction_forces(y_local, y_full, hidx, hval, exag,
+                                 row_chunk=cfg.row_chunk, kernel=kern)
+               + _edge_forces(y_local, y_full, tsrc, tdst, tval, exag))
+    elif edges is not None and edges_extra:
         # split-blocks layout (affinities.symmetrize_split_blocks): the
         # rows part is the width-k forward block with merged values, the
         # edges part the reverse-only entries — attraction is their sum
-        att, loss = _attractive_forces(y_local, y_full, jidx, jval,
-                                       exag, z, row_chunk=cfg.row_chunk,
-                                       row_loss=row_r)
-        att_r, loss_r = _attractive_forces_edges(y_local, y_full, *edges,
-                                                 exag, z, row_loss=row_r)
-        att, loss = att + att_r, loss + loss_r
+        att = (attraction_forces(y_local, y_full, jidx, jval, exag,
+                                 row_chunk=cfg.row_chunk, kernel=kern)
+               + _edge_forces(y_local, y_full, *edges, exag))
     elif edges is not None:
-        att, loss = _attractive_forces_edges(y_local, y_full, *edges,
-                                             exag, z, row_loss=row_r)
+        att = _edge_forces(y_local, y_full, *edges, exag)
     else:
-        att, loss = _attractive_forces(y_local, y_full, jidx, jval,
-                                       exag, z, row_chunk=cfg.row_chunk,
-                                       row_loss=row_r)
-    loss = _mesh_sum(loss, axis_name) if row_r else _psum(loss, axis_name)
+        att = attraction_forces(y_local, y_full, jidx, jval, exag,
+                                row_chunk=cfg.row_chunk, kernel=kern)
+    # canonical dtype: forces ride the STATE dtype (mixed f64 affinities
+    # over an f32 state must not promote the update/carry)
+    return att.astype(y_local.dtype)
+
+
+def _attraction_loss(y_local, y_full, jidx, jval, cfg: TsneConfig, exag, z,
+                     edges=None, edges_extra=False, csr=None):
+    """Per-row partial KL Σ p log(p/(q/Z)) (TsneHelpers.scala:297-300)
+    for the armed layout — the mesh-canonical [nloc] form (sum it for
+    the scalar).  A separate pass from the forces ON PURPOSE: the
+    optimize body gates it on the loss-report predicate, so 9 of 10
+    iterations never run the log/where chain (graftstep)."""
+    from tsne_flink_tpu.ops.attraction_pallas import attraction_loss
+    kern = _att_kernel()
+    if csr is not None:
+        hidx, hval, tsrc, tdst, tval = csr
+        loss = (attraction_loss(y_local, y_full, hidx, hval, exag, z,
+                                row_chunk=cfg.row_chunk, kernel=kern)
+                + _edge_loss(y_local, y_full, tsrc, tdst, tval, exag, z))
+    elif edges is not None and edges_extra:
+        loss = (attraction_loss(y_local, y_full, jidx, jval, exag, z,
+                                row_chunk=cfg.row_chunk, kernel=kern)
+                + _edge_loss(y_local, y_full, *edges, exag, z))
+    elif edges is not None:
+        loss = _edge_loss(y_local, y_full, *edges, exag, z)
+    else:
+        loss = attraction_loss(y_local, y_full, jidx, jval, exag, z,
+                               row_chunk=cfg.row_chunk, kernel=kern)
+    # canonical dtype: the loss trace rides the STATE dtype (mixed f64
+    # affinities over an f32 state would otherwise promote the cond
+    # branches apart)
+    return loss.astype(y_local.dtype)
+
+
+def _gradient(y_local, jidx, jval, cfg: TsneConfig, exag,
+              axis_name=None, row_offset=0, valid_full=None, edges=None,
+              edges_extra=False, csr=None, want_loss=None,
+              rep_scratch=None):
+    """grad_i = F_attr_i − F_rep_i / Z (TsneHelpers.scala:311-317).
+
+    ``valid_full`` is the GLOBAL point-validity mask (already gathered once,
+    outside the iteration loop — it is loop-invariant).  ``want_loss``
+    (traced bool, or None = always) gates the KL pass: the forces never
+    need the loss chain, so off-report iterations skip it via ``lax.cond``
+    and return 0.0 (the recorded slots are computed on their own
+    iteration, unchanged).
+
+    Under a mesh (``axis_name`` given) the Z and KL reductions are
+    mesh-canonical (graftmesh): per-row partials reduced by
+    :func:`_mesh_sum` in one fixed order (the FFT backend's spectral Z is
+    replicated by construction), so every mesh width sharing the padding
+    quantum reproduces the same bits."""
+    y_full = (y_local if axis_name is None
+              else lax.all_gather(y_local, axis_name, tiled=True))
+    rep, z = _repulsion(y_local, y_full, cfg, axis_name, row_offset,
+                        valid_full, rep_scratch)
+    att = _attraction_forces(y_local, y_full, jidx, jval, cfg, exag,
+                             edges=edges, edges_extra=edges_extra, csr=csr)
+
+    def loss_fn():
+        return _attraction_loss(y_local, y_full, jidx, jval, cfg, exag, z,
+                                edges=edges, edges_extra=edges_extra,
+                                csr=csr)
+
+    if want_loss is None:
+        loss_rows = loss_fn()
+    else:
+        # the collective stays OUTSIDE the cond (both branches must be
+        # collective-free so every mesh width takes them uniformly)
+        loss_rows = lax.cond(want_loss, loss_fn,
+                             lambda: jnp.zeros((y_local.shape[0],),
+                                               y_local.dtype))
+    loss = (_mesh_sum(loss_rows, axis_name) if axis_name is not None
+            else jnp.sum(loss_rows))
     return att - rep / z, loss
 
 
@@ -374,7 +432,7 @@ def center_input(x: jnp.ndarray, axis_name=None, valid=None) -> jnp.ndarray:
 def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
              axis_name=None, row_offset=0, valid=None,
              start_iter=0, num_iters: int | None = None,
-             loss_carry=None, edges=None, edges_extra=False,
+             loss_carry=None, edges=None, edges_extra=False, csr=None,
              with_health=False, with_telemetry=False,
              telemetry_carry=None):
     """Full 3-phase gradient descent as ONE compiled fori_loop.
@@ -406,33 +464,90 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
     to one full run; ``telemetry_carry`` threads it between segments).
     It is returned AFTER the losses (and before the health flag); off =
     today's program, bit for bit (pinned by tests/test_obs.py).
+
+    graftstep: ``csr`` arms the capped-width CSR attraction layout
+    (``(hidx, hval, tsrc, tdst, tval)`` — ops/attraction_pallas); the KL
+    pass is computed only on report iterations (``lax.cond`` inside
+    ``_gradient`` — unless the sentinel is armed, which reads the loss's
+    finiteness every iteration); the FFT lattice is built ONCE here and
+    closed over by the body; and ``cfg.repulsion_stride > 1`` (opt-in,
+    approximate) carries (rep, Z) in the loop and refreshes them every
+    stride-th absolute iteration — stride 1 is bit-identical to the
+    carried-free program (the carry does not exist).
     """
     m0 = jnp.asarray(cfg.initial_momentum, state.y.dtype)
     m1 = jnp.asarray(cfg.final_momentum, state.y.dtype)
     alpha = jnp.asarray(cfg.early_exaggeration, state.y.dtype)
     one = jnp.ones((), state.y.dtype)
     n_slots = max(cfg.n_loss_slots, 1)
+    stride = max(1, int(getattr(cfg, "repulsion_stride", 1)))
     # the validity mask is loop-invariant: gather it to global form ONCE here,
     # not inside the fori_loop (XLA does not hoist collectives out of loops)
     valid_full = (valid if axis_name is None or valid is None
                   else lax.all_gather(valid, axis_name, tiled=True))
+    # loop-invariant repulsion scratch (graftstep): the FFT circulant
+    # lattice is built once and closed over by the body — each iteration
+    # only rescales it by the dynamic node spacing
+    rep_scratch = _repulsion_scratch(cfg, state.y.shape[1], state.y.dtype)
+    num = cfg.iterations if num_iters is None else num_iters
+    start = jnp.asarray(start_iter, jnp.int32)
 
     def body(i, carry):
         st, loss_arr = carry[0], carry[1]
-        tel_arr = carry[2] if with_telemetry else None
-        ok = carry[-1] if with_health else None
+        nxt = 2
+        tel_arr = None
+        if with_telemetry:
+            tel_arr = carry[nxt]
+            nxt += 1
+        ok = carry[nxt] if with_health else None
+        rep_c = z_c = None
+        if stride > 1:
+            rep_c, z_c = carry[-2], carry[-1]
         momentum = jnp.where(i < cfg.momentum_switch, m0, m1)
         exag = jnp.where(i < cfg.exaggeration_end, alpha, one)
-        grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
-                               axis_name=axis_name, row_offset=row_offset,
-                               valid_full=valid_full, edges=edges,
-                               edges_extra=edges_extra)
+        # KL gate: the loss is only READ at the report interval; with the
+        # sentinel armed it must be checked every iteration (None = always)
+        record = (i + 1) % LOSS_EVERY == 0
+        want_loss = None if with_health else record
+        if stride == 1:
+            grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
+                                   axis_name=axis_name,
+                                   row_offset=row_offset,
+                                   valid_full=valid_full, edges=edges,
+                                   edges_extra=edges_extra, csr=csr,
+                                   want_loss=want_loss,
+                                   rep_scratch=rep_scratch)
+        else:
+            # opt-in repulsion amortization: refresh (rep, Z) only every
+            # stride-th absolute iteration (and at the segment start),
+            # carry them donated in between — the attraction and update
+            # stay exact every iteration
+            y_full = (st.y if axis_name is None
+                      else lax.all_gather(st.y, axis_name, tiled=True))
+            refresh = (i == start) | (i % stride == 0)
+            rep_c, z_c = lax.cond(
+                refresh,
+                lambda: _repulsion(st.y, y_full, cfg, axis_name,
+                                   row_offset, valid_full, rep_scratch),
+                lambda: (rep_c, z_c))
+            att = _attraction_forces(st.y, y_full, jidx, jval, cfg, exag,
+                                     edges=edges, edges_extra=edges_extra,
+                                     csr=csr)
+            def _loss_rows():
+                return _attraction_loss(st.y, y_full, jidx, jval, cfg,
+                                        exag, z_c, edges=edges,
+                                        edges_extra=edges_extra, csr=csr)
+            loss_rows = (_loss_rows() if want_loss is None else lax.cond(
+                want_loss, _loss_rows,
+                lambda: jnp.zeros((st.y.shape[0],), st.y.dtype)))
+            loss = (_mesh_sum(loss_rows, axis_name)
+                    if axis_name is not None else jnp.sum(loss_rows))
+            grad = att - rep_c / z_c
         if valid is not None:
             grad = grad * valid[:, None].astype(grad.dtype)
         st = _update_embedding(st, grad, momentum, cfg)
         st = _center(st, axis_name=axis_name, valid=valid)
         slot = jnp.minimum((i + 1) // LOSS_EVERY - 1, n_slots - 1)
-        record = (i + 1) % LOSS_EVERY == 0
         loss_arr = loss_arr.at[slot].set(
             jnp.where(record, loss, loss_arr[slot]))
         out = [st, loss_arr]
@@ -449,12 +564,12 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
             ok = (ok & jnp.all(jnp.isfinite(st.y))
                   & jnp.all(jnp.isfinite(st.gains)) & jnp.isfinite(loss))
             out.append(ok)
+        if stride > 1:
+            out.extend([rep_c, z_c])
         return tuple(out)
 
     loss0 = (loss_carry if loss_carry is not None
              else jnp.zeros((n_slots,), state.y.dtype))
-    num = cfg.iterations if num_iters is None else num_iters
-    start = jnp.asarray(start_iter, jnp.int32)
     init = [state, loss0]
     if with_telemetry:
         init.append(telemetry_carry if telemetry_carry is not None
@@ -462,6 +577,16 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
                                    state.y.dtype))
     if with_health:
         init.append(jnp.asarray(True))
+    if stride > 1:
+        init.extend([jnp.zeros_like(state.y),
+                     jnp.ones((), state.y.dtype)])
+    # graftlint: disable=carry-hygiene -- loop-INVARIANT operand closures:
+    # jidx/jval/edges/csr/valid_full/rep_scratch are read-only jit inputs
+    # XLA holds in ONE buffer across iterations (nothing re-materializes
+    # per step); cfg/axis_name/stride/flags are trace-time statics; every
+    # array the body MUTATES (state, loss/telemetry traces, sentinel flag,
+    # the stride's rep/z) rides the carry and is donated at the segment
+    # boundary (parallel/mesh._segment_fn donate_argnums)
     out = lax.fori_loop(start, start + num, body, tuple(init))
     state, losses = out[0], out[1]
     res = [state, losses]
@@ -470,7 +595,8 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
     if with_health:
         # one scalar collective AFTER the loop makes the flag global (and
         # replication-invariant under shard_map out_specs P())
-        bad = _psum((~out[-1]).astype(jnp.int32), axis_name)
+        bad = _psum((~out[2 + int(with_telemetry)]).astype(jnp.int32),
+                    axis_name)
         res.append(bad == 0)
     return tuple(res)
 
@@ -532,10 +658,15 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
         return state.y, losses
     # graftlint: disable=jit-hygiene -- one-shot run, same rationale as above
     run = jax.jit(partial(optimize, cfg=cfg, edges_extra=False))
-    edges = None
-    from tsne_flink_tpu.ops.affinities import assemble_edges, plan_edges
-    use_edges, e_pad = plan_edges(jidx, jval, cfg.attraction)
-    if use_edges:
-        edges = jax.jit(partial(assemble_edges, e_pad=e_pad))(jidx, jval)
-    state, losses = run(state, jidx, jval, edges=edges)
+    edges = csr = None
+    from tsne_flink_tpu.ops.affinities import (assemble_edges,
+                                               plan_attraction)
+    layout, param = plan_attraction(jidx, jval, cfg.attraction)
+    if layout == "csr":
+        from tsne_flink_tpu.ops.attraction_pallas import build_csr
+        head, tail = build_csr(jidx, jval, param)
+        csr = head + tail
+    elif layout == "edges":
+        edges = jax.jit(partial(assemble_edges, e_pad=param))(jidx, jval)
+    state, losses = run(state, jidx, jval, edges=edges, csr=csr)
     return state.y, losses

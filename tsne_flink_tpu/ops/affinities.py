@@ -33,7 +33,10 @@ MAX_BISECT_STEPS = 50
 H_TOL = 1e-5
 ZERO_SUM_GUARD = 1e-7
 P_FLOOR = 1e-12  # the intended clamp at TsneHelpers.scala:191,194
-ATTRACTION_MODES = ("auto", "rows", "edges")  # plan_edges / CLI / bench
+ATTRACTION_MODES = ("auto", "rows", "edges", "csr")  # plan_attraction /
+# plan_edges / CLI / bench — "csr" is the graftstep capped-width head +
+# overflow-tail layout (ops/attraction_pallas), the auto winner where the
+# flat edge list used to be
 
 #: bool control flags of the joint-distribution builders — always static
 #: under jit (the jit-hygiene lint rule): traced, they could not drive the
@@ -623,6 +626,37 @@ def plan_edges(jidx: jnp.ndarray, jval: jnp.ndarray, mode: str = "auto",
         # assemble_edges); auto declines, explicit "edges" raises there
     e_pad = edge_count(jval, multiple)
     return (mode == "edges" or edges_beneficial(e_pad, n_rows, s)), e_pad
+
+
+def plan_attraction(jidx, jval, mode: str = "auto"):
+    """THE attraction-layout decision since graftstep, shared by every
+    host-staged entry point (``tsne_embed``, ``ShardedOptimizer``,
+    ``bench.py``) so the policy cannot drift between them.  Returns
+    ``(layout, param)``:
+
+    * ``("rows", 0)`` — the padded [N, S] row sweep;
+    * ``("edges", e_pad)`` — the flat COO list (explicit request only;
+      multi-controller runs also use it in-trace);
+    * ``("csr", width)`` — the capped-width CSR head + overflow tail
+      (``ops/attraction_pallas.build_csr``), what ``auto`` now resolves
+      to on the hub-heavy graphs where the edge list used to win (same
+      :func:`edges_beneficial` gate, decided on GLOBAL quantities so
+      every mesh width agrees).
+
+    Host sync (edge count) — preprocessing only."""
+    if mode not in ATTRACTION_MODES:
+        raise ValueError(f"attraction mode '{mode}' not defined "
+                         f"({' | '.join(ATTRACTION_MODES)})")
+    if mode == "rows":
+        return "rows", 0
+    n_rows, s = jidx.shape
+    if mode == "edges":
+        return "edges", edge_count(jval)
+    e_pad = edge_count(jval)
+    if mode == "csr" or edges_beneficial(e_pad, n_rows, s):
+        from tsne_flink_tpu.ops.attraction_pallas import pick_csr_width
+        return "csr", pick_csr_width(e_pad, n_rows, s)
+    return "rows", 0
 
 
 def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,

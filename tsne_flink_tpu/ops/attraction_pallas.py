@@ -1,0 +1,403 @@
+"""graftstep: the fused attraction step — CSR row tiles through one kernel.
+
+The attraction sweep is the optimize loop's hot half (r8: the edge-layout
+``segment_sum`` pair alone was ~1.1 s of the 1.63 s/iter 60k CPU
+iteration — XLA lowers a sorted segment reduction to a sequential
+scatter).  This module replaces the per-edge scatter with a CSR form
+whose per-row accumulation is a vectorized reduction:
+
+* **capped-width CSR** (:func:`build_csr`): the symmetrized ``[N, S]``
+  row layout is compacted ONCE per run (host-side, iteration-invariant)
+  into a ``[N, W]`` head — each row's first ``W`` valid entries at
+  ``W`` ≈ the mean symmetrized degree (:func:`pick_csr_width`, hub rows
+  excepted) — plus a flat COO tail holding the few hub rows' overflow
+  (~15-25% of the edges at the 60k bench shape).  The head reduces per
+  row with a fixed-shape ``sum`` (no scatter); only the small tail pays
+  the sorted ``segment_sum``.
+* **one fused kernel per row tile**: the head's per-chunk math (gathered
+  neighbor tile -> squared distances by the norm trick -> Student-t
+  weights -> force/loss accumulation) runs as a single Pallas kernel on
+  TPU (``[TR, W, MPAD]`` tiles resident in VMEM, per-row accumulation
+  in-kernel — the ``ops/knn_pallas.py`` recorded-policy shape:
+  :func:`pick_attraction_kernel` with a Mosaic probe, interpret-mode CPU
+  parity, XLA fallback) and as the norm-trick einsum form under XLA —
+  which materializes only the neighbor gather and ``[c, W]`` planes, not
+  the old metric-path ``[c, S, m]`` difference/square transients.
+* **forces and loss are separate passes** (:func:`attraction_forces` /
+  :func:`attraction_loss`): the KL term is only *read* every
+  ``LOSS_EVERY``-th iteration (TsneHelpers.scala:297), so the optimize
+  body gates the loss pass on the report predicate (``lax.cond``) and 9
+  of 10 iterations skip the log/where chain entirely.  Values at the
+  recorded slots are unchanged.
+
+Bit-identity contract (graftmesh): the ``[N, W]`` head is a row-major
+slice-per-shard of one global array and its per-row reduction tree is a
+function of ``W`` alone; the tail scatter keeps sorted sequential
+per-row semantics — so every mesh width sharing the padding quantum
+reproduces the same bits, exactly like the layouts it replaces
+(pinned by tests/test_mesh.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MPAD = 8      # f32 sublane minimum: embedding dims padded 2/3 -> 8
+TILE_ROWS = 8  # rows per kernel invocation ([TR, W, MPAD] stays in VMEM)
+
+#: VMEM budget for one [TR, W, MPAD] neighbor tile (+ yc/val/outputs):
+#: beyond this the Pallas path demotes to XLA (wide rows-layout calls).
+PALLAS_ATT_TILE_BYTES = 4 << 20
+
+#: padding multiple of the CSR tail edge list (static shapes across
+#: re-preparations of similar graphs, mirroring assemble_edges' 1024).
+TAIL_MULTIPLE = 1024
+
+
+# ---- CSR cap policy + one-time build ---------------------------------------
+
+def pick_csr_width(n_edges: int, n_rows: int, s: int) -> int:
+    """THE head-width policy: ~1.3x the global mean symmetrized degree,
+    rounded up to a 64-lane multiple (64 <= W <= S).  Decided on GLOBAL
+    quantities only, so every mesh width agrees (the layout-gate rule of
+    ``ShardedOptimizer.attraction_plan``).  ``TSNE_ATTRACTION_WIDTH``
+    overrides for A/B evidence runs."""
+    from tsne_flink_tpu.utils.env import env_int
+    override = env_int("TSNE_ATTRACTION_WIDTH")
+    if override:
+        return max(1, min(int(s), int(override)))
+    mean = n_edges / max(1, n_rows)
+    w = math.ceil(1.3 * mean / 64) * 64
+    return int(min(s, max(64, w)))
+
+
+def csr_tail_pad(n_tail: int) -> int:
+    return max(TAIL_MULTIPLE,
+               math.ceil(n_tail / TAIL_MULTIPLE) * TAIL_MULTIPLE)
+
+
+def build_csr(jidx, jval, width: int):
+    """Padded row layout ``[N, S]`` -> (head ``[N, W]`` idx/val, tail COO).
+
+    One host-side compaction pass (numpy ``flatnonzero`` — the device
+    scatter this replaces was ~25 s and a ~2.5 GiB transient at the 60k
+    shape, the very allocation the r8 memory drift pointed at).  Each
+    row's valid entries keep their row-major order: the first ``W`` land
+    in the head (missing entries carry val = 0 -> zero force and loss),
+    the overflow becomes a flat (src, dst, val) tail sorted by src with
+    the ``assemble_edges`` padding convention (src = n-1, dst = 0,
+    val = 0 — ascending src end to end, so ``segment_sum`` consumers may
+    pass ``indices_are_sorted=True``)."""
+    # graftlint: disable=host-sync -- deliberate: one-time host-side
+    # preprocessing per optimize run (NOT per iteration) — the numpy
+    # compaction replaces a device scatter that was 6-10x slower and the
+    # top optimize-stage memory transient (r8 drift evidence)
+    ji = np.asarray(jidx)
+    # graftlint: disable=host-sync -- same one-time preprocessing read
+    jv = np.asarray(jval)
+    n, s = ji.shape
+    w = int(min(width, s))
+    flat = np.flatnonzero((jv > 0).ravel())
+    rows = (flat // s).astype(np.int64)
+    deg = np.bincount(rows, minlength=n)
+    row_start = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=row_start[1:])
+    rank = np.arange(len(flat), dtype=np.int64) - row_start[rows]
+    jif = ji.ravel()[flat]
+    jvf = jv.ravel()[flat]
+    head = rank < w
+    hidx = np.zeros((n, w), np.int32)
+    hval = np.zeros((n, w), jv.dtype)
+    pos = rows[head] * w + rank[head]
+    hidx.ravel()[pos] = jif[head]
+    hval.ravel()[pos] = jvf[head]
+    tail = ~head
+    n_tail = int(tail.sum())
+    e_pad = csr_tail_pad(n_tail)
+    tsrc = np.full((e_pad,), n - 1, np.int32)
+    tdst = np.zeros((e_pad,), np.int32)
+    tval = np.zeros((e_pad,), jv.dtype)
+    tsrc[:n_tail] = rows[tail]
+    tdst[:n_tail] = jif[tail]
+    tval[:n_tail] = jvf[tail]
+    return ((jnp.asarray(hidx), jnp.asarray(hval)),
+            (jnp.asarray(tsrc), jnp.asarray(tdst), jnp.asarray(tval)))
+
+
+# ---- the fused per-row-tile kernels ----------------------------------------
+
+def _forces_kernel(yc_ref, yj_ref, val_ref, sc_ref, att_ref):
+    """One [TR, W] row tile: norm-trick distances + Student-t weights +
+    in-kernel per-row force accumulation.  ``sc_ref`` carries the traced
+    exaggeration scalar (SMEM)."""
+    yc = yc_ref[:]                                   # [TR, MPAD]
+    yj = yj_ref[:]                                   # [TR, W, MPAD]
+    val = val_ref[:]                                 # [TR, W]
+    d2 = (jnp.sum(yc * yc, axis=1, keepdims=True)
+          + jnp.sum(yj * yj, axis=2)
+          - 2.0 * jnp.sum(yc[:, None, :] * yj, axis=2))
+    q = 1.0 / (1.0 + jnp.maximum(d2, 0.0))           # [TR, W]
+    w = val * sc_ref[0, 0] * q
+    att_ref[:] = (yc * jnp.sum(w, axis=1, keepdims=True)
+                  - jnp.sum(w[:, :, None] * yj, axis=1))
+
+
+def _loss_kernel(yc_ref, yj_ref, val_ref, sc_ref, loss_ref):
+    """Per-row partial KL of one [TR, W] tile (sc: [exag, z] in SMEM)."""
+    yc = yc_ref[:]
+    yj = yj_ref[:]
+    val = val_ref[:]
+    d2 = (jnp.sum(yc * yc, axis=1, keepdims=True)
+          + jnp.sum(yj * yj, axis=2)
+          - 2.0 * jnp.sum(yc[:, None, :] * yj, axis=2))
+    q = 1.0 / (1.0 + jnp.maximum(d2, 0.0))
+    pe = val * sc_ref[0, 0]
+    mask = val > 0
+    pe_safe = jnp.where(mask, pe, 1.0)
+    q_safe = jnp.where(mask, q, 1.0)
+    terms = jnp.where(mask, pe * jnp.log(pe_safe * sc_ref[0, 1] / q_safe),
+                      0.0)
+    loss_ref[:] = jnp.sum(terms, axis=1, keepdims=True)
+
+
+def _pad_rows(a, to, fill=0.0):
+    pad = -a.shape[0] % to
+    if pad == 0:
+        return a
+    return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1),
+                   constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "row_tile"))
+def _run_forces(yc, yj, val, exag, *, interpret=False, row_tile=TILE_ROWS):
+    """Pallas head forces for one chunk: (att [c, m])."""
+    c, m = yc.shape
+    w = yj.shape[1]
+    f32 = jnp.float32
+    rt = min(row_tile, c)
+    ycp = _pad_rows(jnp.pad(yc.astype(f32), ((0, 0), (0, MPAD - m))), rt)
+    yjp = _pad_rows(jnp.pad(yj.astype(f32),
+                            ((0, 0), (0, 0), (0, MPAD - m))), rt)
+    vp = _pad_rows(val.astype(f32), rt)
+    nb = ycp.shape[0] // rt
+    sc = jnp.asarray(exag, f32).reshape(1, 1)
+    att = pl.pallas_call(
+        _forces_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((rt, MPAD), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rt, w, MPAD), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rt, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((rt, MPAD), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * rt, MPAD), f32),
+        cost_estimate=pl.CostEstimate(
+            flops=float(nb * rt) * w * (5.0 * MPAD + 9.0),
+            bytes_accessed=float(nb * rt) * w * (MPAD + 2.0) * 4.0,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(ycp, yjp, vp, sc)
+    return att[:c, :m].astype(yc.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "row_tile"))
+def _run_loss(yc, yj, val, exag, z, *, interpret=False, row_tile=TILE_ROWS):
+    """Pallas head loss for one chunk: per-row partial KL [c]."""
+    c, m = yc.shape
+    w = yj.shape[1]
+    f32 = jnp.float32
+    rt = min(row_tile, c)
+    ycp = _pad_rows(jnp.pad(yc.astype(f32), ((0, 0), (0, MPAD - m))), rt)
+    yjp = _pad_rows(jnp.pad(yj.astype(f32),
+                            ((0, 0), (0, 0), (0, MPAD - m))), rt)
+    vp = _pad_rows(val.astype(f32), rt)
+    nb = ycp.shape[0] // rt
+    sc = jnp.stack([jnp.asarray(exag, f32),
+                    jnp.asarray(z, f32)]).reshape(1, 2)
+    loss = pl.pallas_call(
+        _loss_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((rt, MPAD), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rt, w, MPAD), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rt, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((rt, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * rt, 1), f32),
+        cost_estimate=pl.CostEstimate(
+            flops=float(nb * rt) * w * (3.0 * MPAD + 12.0),
+            bytes_accessed=float(nb * rt) * w * (MPAD + 2.0) * 4.0,
+            transcendentals=float(nb * rt) * w,
+        ),
+        interpret=interpret,
+    )(ycp, yjp, vp, sc)
+    return loss[:c, 0].astype(yc.dtype)
+
+
+# ---- XLA twins --------------------------------------------------------------
+
+def _xla_forces(yc, yj, val, exag):
+    """Norm-trick einsum form: only the neighbor gather and [c, W] planes
+    are materialized — no [c, W, m] difference/square transients (the
+    old metric-path form the r8 drift pointed at)."""
+    d2 = (jnp.sum(yc * yc, axis=1)[:, None]
+          + jnp.sum(yj * yj, axis=2)
+          - 2.0 * jnp.einsum("cm,cwm->cw", yc, yj))
+    q = 1.0 / (1.0 + jnp.maximum(d2, 0.0))
+    w = val * exag * q
+    return (yc * jnp.sum(w, axis=1)[:, None]
+            - jnp.einsum("cw,cwm->cm", w, yj))
+
+
+def _xla_loss(yc, yj, val, exag, z):
+    d2 = (jnp.sum(yc * yc, axis=1)[:, None]
+          + jnp.sum(yj * yj, axis=2)
+          - 2.0 * jnp.einsum("cm,cwm->cw", yc, yj))
+    q = 1.0 / (1.0 + jnp.maximum(d2, 0.0))
+    pe = val * exag
+    mask = val > 0
+    pe_safe = jnp.where(mask, pe, 1.0)
+    q_safe = jnp.where(mask, q, 1.0)
+    terms = jnp.where(mask, pe * jnp.log(pe_safe * z / q_safe), 0.0)
+    return jnp.sum(terms, axis=1)
+
+
+# ---- chunked entry points ---------------------------------------------------
+
+def _chunked(y_local, jidx, jval, row_chunk):
+    nloc, m = y_local.shape
+    s = jidx.shape[1]
+    c = min(row_chunk, nloc)
+    nchunks = math.ceil(nloc / c)
+    pad = nchunks * c - nloc
+    yp = jnp.pad(y_local, ((0, pad), (0, 0)))
+    ip = jnp.pad(jidx, ((0, pad), (0, 0)))
+    vp = jnp.pad(jval, ((0, pad), (0, 0)))
+    return (yp.reshape(nchunks, c, m), ip.reshape(nchunks, c, s),
+            vp.reshape(nchunks, c, s)), nloc, c
+
+
+def _resolve(kernel, s):
+    k = kernel if kernel is not None else pick_attraction_kernel()
+    if (k.startswith("pallas")
+            and TILE_ROWS * s * MPAD * 4 > PALLAS_ATT_TILE_BYTES):
+        return "xla"  # a [TR, S, MPAD] tile would blow the VMEM budget
+    return k
+
+
+def attraction_forces(y_local, y_full, jidx, jval, exag, *,
+                      row_chunk: int = 4096, kernel: str | None = None):
+    """F_attr over a CSR row block (head [nloc, W] or the full [N, S]
+    rows layout — same code, different width): row-chunked so the
+    neighbor gather stays a bounded [c, W, m] tile.  Returns [nloc, m]."""
+    kern = _resolve(kernel, jidx.shape[1])
+    (yc, ic, vc), nloc, _c = _chunked(y_local, jidx, jval, row_chunk)
+
+    def one_chunk(args):
+        ycc, icc, vcc = args
+        yj = y_full[icc]
+        if kern.startswith("pallas"):
+            return _run_forces(ycc, yj, vcc, exag,
+                               interpret=kern == "pallas-interpret")
+        return _xla_forces(ycc, yj, vcc, exag)
+
+    att = lax.map(one_chunk, (yc, ic, vc))
+    return att.reshape(-1, y_local.shape[1])[:nloc]
+
+
+def attraction_loss(y_local, y_full, jidx, jval, exag, z, *,
+                    row_chunk: int = 4096, kernel: str | None = None):
+    """Per-row partial KL over a CSR row block: [nloc] (sum it for the
+    scalar form — the per-row vector IS the mesh-canonical shape
+    ``models/tsne._mesh_sum`` reduces)."""
+    kern = _resolve(kernel, jidx.shape[1])
+    (yc, ic, vc), nloc, _c = _chunked(y_local, jidx, jval, row_chunk)
+
+    def one_chunk(args):
+        ycc, icc, vcc = args
+        yj = y_full[icc]
+        if kern.startswith("pallas"):
+            return _run_loss(ycc, yj, vcc, exag, z,
+                             interpret=kern == "pallas-interpret")
+        return _xla_loss(ycc, yj, vcc, exag, z)
+
+    loss = lax.map(one_chunk, (yc, ic, vc))
+    return loss.reshape(-1)[:nloc]
+
+
+# ---- kernel selection policy ------------------------------------------------
+
+_MOSAIC_ATT_OK: bool | None = None
+
+
+def mosaic_attraction_supported() -> bool:
+    """One-time probe: compile + run the forces kernel on a tiny input on
+    the REAL backend, so a Mosaic lowering rejection demotes
+    ``kernel=auto`` to the XLA twin with a warning instead of killing the
+    first hardware run — the same contract as ``mosaic_knn_supported``."""
+    global _MOSAIC_ATT_OK
+    if _MOSAIC_ATT_OK is None:
+        if jax.default_backend() != "tpu":
+            _MOSAIC_ATT_OK = True  # interpret mode: nothing to lower
+        else:
+            try:
+                with jax.ensure_compile_time_eval():
+                    y = jnp.zeros((TILE_ROWS, 2), jnp.float32)
+                    yj = jnp.zeros((TILE_ROWS, 128, 2), jnp.float32)
+                    v = jnp.ones((TILE_ROWS, 128), jnp.float32)
+                    att = _run_forces(y, yj, v,
+                                      jnp.asarray(1.0, jnp.float32),
+                                      interpret=False)
+                    # graftlint: disable=host-sync -- deliberate: the probe
+                    # must force the kernel to a concrete value once,
+                    # outside any hot path, to prove Mosaic lowers it
+                    _MOSAIC_ATT_OK = bool(jnp.all(jnp.isfinite(att)))
+            except Exception as e:  # Mosaic/XLA lowering errors vary widely
+                import sys
+                print("WARNING: pallas attraction kernel failed to lower on "
+                      f"this TPU ({type(e).__name__}: {str(e)[:200]}); "
+                      "kernel=auto falls back to the XLA form",
+                      file=sys.stderr)
+                _MOSAIC_ATT_OK = False
+    return _MOSAIC_ATT_OK
+
+
+def pick_attraction_kernel(backend: str | None = None) -> str:
+    """THE attraction kernel policy (recorded like ``pick_knn_kernel``):
+    ``pallas`` on TPU behind the Mosaic probe, the XLA einsum twin
+    everywhere else.  ``TSNE_ATTRACTION_KERNEL`` overrides: ``pallas`` |
+    ``interpret`` (interpret-mode Pallas — the CPU parity configuration) |
+    ``xla`` | ``auto``.  Foreign-backend calls (graftcheck planning) skip
+    the probe; the runtime probe still guards the actual launch."""
+    from tsne_flink_tpu.utils.env import env_str
+    mode = env_str("TSNE_ATTRACTION_KERNEL")
+    if mode == "interpret":
+        return "pallas-interpret"
+    if mode in ("pallas", "xla"):
+        return mode
+    if backend is None:
+        backend = jax.default_backend()
+    if backend == "tpu":
+        if jax.default_backend() != "tpu" or mosaic_attraction_supported():
+            return "pallas"
+    return "xla"

@@ -133,38 +133,48 @@ def _pad_axis(a, to: int, axis: int = 0, fill=0.0):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "k", "metric", "interpret", "row_tile", "col_tile"))
-def _run_fused(x, k: int, metric: str = "sqeuclidean", *,
-               interpret: bool = False, row_tile: int = TILE_R,
-               col_tile: int = TILE_C):
-    """Full N x N fused sweep -> (idx [N, k] int32, dist [N, k] ascending)."""
-    from tsne_flink_tpu.ops.metrics import matmul_dtype
+    "metric", "row_tile", "col_tile"))
+def _fused_prep(x, metric: str = "sqeuclidean", *, row_tile: int = TILE_R,
+                col_tile: int = TILE_C):
+    """Stage 1 of the fused sweep — operand staging: metric base
+    (cosine normalization), feature-lane pad, row/col tile pads, and the
+    valid-count SMEM scalar.  Split out so the exact-method bench record
+    can attribute 'tile setup' separately (graftstep satellite)."""
     from tsne_flink_tpu.ops.knn import cosine_zbase
 
-    n, dim = x.shape
-    cosine = metric == "cosine"
-    base = cosine_zbase(x) if cosine else x
+    n = x.shape[0]
+    base = cosine_zbase(x) if metric == "cosine" else x
     # lane-pad the feature axis (zero columns feed zeros to both the dot
     # product and the norms, so distances are untouched)
     base = _pad_axis(base, LANES, axis=1)
     rows = _pad_axis(base, row_tile)
     cols = _pad_axis(base, col_tile)
+    return rows, cols, jnp.full((1, 1), n, jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "metric", "interpret", "row_tile", "col_tile"))
+def _fused_sweep(rows, cols, nv, k: int, metric: str = "sqeuclidean", *,
+                 interpret: bool = False, row_tile: int = TILE_R,
+                 col_tile: int = TILE_C):
+    """Stage 2 — the N x N Mosaic sweep itself: returns the raw [N, KPAD]
+    accumulator pair (the only HBM transients, module docstring)."""
+    from tsne_flink_tpu.ops.metrics import matmul_dtype
+
     nr = rows.shape[0] // row_tile
     nc = cols.shape[0] // col_tile
     kpad = kpad_for(k)
-    nv = jnp.full((1, 1), n, jnp.int32)
-
     kern = functools.partial(
-        _fused_kernel, ksel=min(k, col_tile), cosine=cosine,
+        _fused_kernel, ksel=min(k, col_tile), cosine=metric == "cosine",
         cast_dtype=matmul_dtype())
-    f = base.dtype
-    dist, idx = pl.pallas_call(
+    f = rows.dtype
+    return pl.pallas_call(
         kern,
         grid=(nr, nc),
         in_specs=[
-            pl.BlockSpec((row_tile, base.shape[1]), lambda i, j: (i, 0),
+            pl.BlockSpec((row_tile, rows.shape[1]), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((col_tile, base.shape[1]), lambda i, j: (j, 0),
+            pl.BlockSpec((col_tile, rows.shape[1]), lambda i, j: (j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
@@ -179,22 +189,55 @@ def _run_fused(x, k: int, metric: str = "sqeuclidean", *,
             jax.ShapeDtypeStruct((nr * row_tile, kpad), jnp.int32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=2.0 * (nr * row_tile) * (nc * col_tile) * base.shape[1]
+            flops=2.0 * (nr * row_tile) * (nc * col_tile) * rows.shape[1]
             + float(min(k, col_tile)) * (nr * row_tile) * (nc * col_tile),
-            bytes_accessed=(nr * row_tile + nc * col_tile) * base.shape[1]
+            bytes_accessed=(nr * row_tile + nc * col_tile) * rows.shape[1]
             * 4 * 2 + nr * row_tile * kpad * 8,
             transcendentals=0,
         ),
         interpret=interpret,
     )(rows, cols, nv)
-    # order the KPAD-lane accumulator rows ascending — a [N, 128]-wide
-    # top_k, noise against the N-column pass this kernel replaces
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k", "metric"))
+def _fused_final(dist, idx, *, n: int, k: int, metric: str = "sqeuclidean"):
+    """Stage 3 — order the KPAD-lane accumulator rows ascending: a
+    [N, 128]-wide top_k, noise against the N-column pass the kernel
+    replaces."""
     neg, sel = lax.top_k(-dist[:n], k)
     d = -neg
     i = jnp.take_along_axis(idx[:n], sel, axis=1)
     if metric == "euclidean":
         d = jnp.sqrt(d)
     return i.astype(jnp.int32), d
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "metric", "interpret", "row_tile", "col_tile"))
+def _run_fused(x, k: int, metric: str = "sqeuclidean", *,
+               interpret: bool = False, row_tile: int = TILE_R,
+               col_tile: int = TILE_C):
+    """Full N x N fused sweep -> (idx [N, k] int32, dist [N, k] ascending):
+    the three stages composed under one jit (the staged forms exist so
+    the decomposed prepare path can time them individually)."""
+    rows, cols, nv = _fused_prep(x, metric, row_tile=row_tile,
+                                 col_tile=col_tile)
+    dist, idx = _fused_sweep(rows, cols, nv, k, metric, interpret=interpret,
+                             row_tile=row_tile, col_tile=col_tile)
+    return _fused_final(dist, idx, n=x.shape[0], k=k, metric=metric)
+
+
+def fused_tiles(n: int, tiles=None) -> tuple[int, int]:
+    """Resolved (row_tile, col_tile) for an N-point fused sweep: the tile
+    plan's VMEM-budgeted edges, shrunk to the padded problem on tiny
+    inputs (parity tests)."""
+    rt, ct = TILE_R, TILE_C
+    if tiles is not None:
+        rt = getattr(tiles, "pallas_rows", rt) or rt
+        ct = getattr(tiles, "pallas_cols", ct) or ct
+    rt = min(rt, max(8, math.ceil(n / 8) * 8))
+    ct = min(ct, max(LANES, math.ceil(n / LANES) * LANES))
+    return rt, ct
 
 
 def fused_knn(x, k: int, metric: str = "sqeuclidean", *,
@@ -209,15 +252,9 @@ def fused_knn(x, k: int, metric: str = "sqeuclidean", *,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    rt, ct = TILE_R, TILE_C
-    if tiles is not None:
-        rt = getattr(tiles, "pallas_rows", rt) or rt
-        ct = getattr(tiles, "pallas_cols", ct) or ct
     n = x.shape[0]
     k = int(min(k, n - 1))
-    # tiny inputs (parity tests): shrink tiles to the padded problem
-    rt = min(rt, max(8, math.ceil(n / 8) * 8))
-    ct = min(ct, max(LANES, math.ceil(n / LANES) * LANES))
+    rt, ct = fused_tiles(n, tiles)
     return _run_fused(x, k, metric, interpret=interpret,
                       row_tile=rt, col_tile=ct)
 

@@ -17,23 +17,48 @@ with the same interpolation weights.  O(N p^m + G^m log G) per iteration
 instead of O(N^2) — and every stage is dense, regular, and MXU/FFT-friendly,
 which is exactly what the TPU wants (this is the 1M-point path).
 
+graftstep (optimize round 2) reworked the per-iteration body in three ways:
+
+* **hoisted geometry** (:func:`fft_geometry`): the integer circulant
+  lattice ``rho2 = |Δu|²`` is iteration-invariant — callers build it ONCE
+  outside the optimize ``fori_loop`` and pass it as ``geom``, so each
+  iteration only does the ``1/(1+h²·rho2)`` rescale (the node spacing
+  ``h`` tracks the embedding's bounding box and is the only dynamic
+  input to the kernel tables).
+* **one-scatter spread**: the p^m stencil taps are concatenated into a
+  single ``segment_sum`` (one scatter pass over ``p^m·N`` updates)
+  instead of p^m separate scatters each allocating and re-adding a full
+  [G^m, nch] grid — measured 2.7x faster at the 60k bench shape and
+  p^m - 1 fewer grid-sized transients.
+* **spectral Z** (Parseval): with the gather weights equal to the spread
+  weights, ``Σ_i φ_K1(y_i) = Σ_x S(x)·(K1⊛S)(x) =
+  (1/M) Σ_k w_k K̂1(k) |Ŝ(k)|²`` over the rfft half-spectrum — the Z
+  convolution needs NO inverse FFT and no per-point gather.  The result
+  is a GLOBAL scalar, identical (bit-for-bit) on every device of a mesh
+  because it is a fixed-order reduction of the replicated spectrum —
+  mesh-canonical by construction, so ``models/tsne._gradient`` uses it
+  directly without a collective.
+
+The convolution arrays are carried channels-FIRST ([nch, (2G)^m]) so the
+FFT axes are the trailing (XLA-native) ones.
+
 Accuracy is governed by the node spacing h = side/G relative to the kernel's
 unit length-scale; with p = 3 and h <= 0.25 the relative force error is ~1e-3
 (see tests/test_fft.py).  The grid size is static under jit; the spacing
 adapts to the embedding's bounding box each iteration.
 
-Self-interactions: K1(0) = 1 contributes N to the Z convolution (subtracted);
-K2(0) * (y_i - y_i) = 0 contributes nothing to the force.
+Self-interactions: K1(0) = 1 contributes N to the Z sum (subtracted — the
+valid-point count is read off the spectrum's DC bin); K2(0) * (y_i - y_i) = 0
+contributes nothing to the force.
 """
 
 from __future__ import annotations
 
 import itertools
-import math
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 #: node spacing must stay well under the kernel's unit scale as the embedding
 #: spreads out late in optimization (span ~100-200 units): 1024 nodes keeps
@@ -44,6 +69,30 @@ from jax import lax
 #: is only fit for tight embeddings, and ``--repulsion auto`` routes
 #: 3-component runs to Barnes-Hut instead (utils/cli.py:pick_repulsion).
 DEFAULT_GRID = {2: 1024, 3: 128}
+
+
+class FftGeom(NamedTuple):
+    """Iteration-invariant grid geometry: the squared integer circulant
+    lattice ``[2G]^m`` (build once per optimize run, close over it in the
+    loop body — the 'FFT plan' the per-iteration math rescales)."""
+
+    rho2: jnp.ndarray
+    grid: int
+
+
+def fft_geometry(m: int, grid: int | None = None,
+                 dtype=jnp.float32) -> FftGeom:
+    g = grid if grid is not None else DEFAULT_GRID.get(m)
+    if g is None:
+        raise ValueError(f"fft repulsion supports 2 or 3 components, got {m}")
+    rho = jnp.minimum(jnp.arange(2 * g), 2 * g - jnp.arange(2 * g)
+                      ).astype(dtype)
+    rho2 = jnp.zeros((2 * g,) * m, dtype)
+    for d in range(m):
+        shape = [1] * m
+        shape[d] = 2 * g
+        rho2 = rho2 + (rho.reshape(shape)) ** 2
+    return FftGeom(rho2=rho2, grid=g)
 
 
 def _lagrange_weights(t: jnp.ndarray, p: int) -> jnp.ndarray:
@@ -65,30 +114,33 @@ def _lagrange_weights(t: jnp.ndarray, p: int) -> jnp.ndarray:
 def fft_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
                   grid: int | None = None, interp: int = 3,
                   row_offset: int = 0, col_valid: jnp.ndarray | None = None,
-                  row_z: bool = False, **_unused):
-    """Same contract as exact_repulsion: (rep [len(y), m], partial-Z scalar
-    — or the per-row partial with ``row_z=True``, the mesh-canonical form).
+                  geom: FftGeom | None = None, **_unused):
+    """Same force contract as exact_repulsion: ``rep [len(y), m]``; the
+    second output is the GLOBAL Z (spectral form, module docstring) — a
+    replicated scalar identical on every shard, NOT a local partial: do
+    not psum it.
 
-    NOTE on sharding: like the BH tree build, the grid is built from the
+    Sharding: like the BH tree build, the grid is built from the
     all-gathered ``y_full`` on every device (the grid is small; rebuilding
-    beats psum-ing it), while gathering happens only for the local rows, so
-    the returned Z is the *local* partial sum — psum it like the others.
+    beats psum-ing it), while gathering happens only for the local rows.
+    ``geom`` is the hoisted :func:`fft_geometry`; None builds it inline
+    (one-shot callers, tests).
     """
     if y_full is None:
         y_full = y
     nloc, m = y.shape
-    nfull = y_full.shape[0]
-    g = grid if grid is not None else DEFAULT_GRID.get(m)
-    if g is None:
-        raise ValueError(f"fft repulsion supports 2 or 3 components, got {m}")
-    p = interp
     dtype = y.dtype
+    if geom is None:
+        geom = fft_geometry(m, grid, dtype)
+    g = geom.grid
+    p = interp
+    half_sten = (p - 1) // 2
+    nch = 1 + m
 
     # bounding box -> node spacing (static grid, dynamic spacing)
     lo = jnp.min(y_full, axis=0)
     hi = jnp.max(y_full, axis=0)
     side = jnp.maximum(jnp.max(hi - lo), jnp.asarray(1e-6, dtype))
-    half_sten = (p - 1) // 2
     h = side / (g - p)  # leaves stencil margin on both sides
     origin = lo - half_sten * h  # low-side margin = stencil reach
 
@@ -96,56 +148,60 @@ def fft_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
     # clip FIRST, then take frac relative to the clipped index — otherwise a
     # boundary point whose floor() lands one node off gets weights for the
     # wrong stencil (measured: 6% force error on the bounding-box corner)
+    nfull = y_full.shape[0]
     u = (y_full - origin[None, :]) / h  # fractional node coords, [N, m]
     idx0 = jnp.clip(jnp.floor(u).astype(jnp.int32),
                     half_sten, g - p + half_sten)
     frac = u - idx0
     wdim = _lagrange_weights(frac, p)  # [N, m, p]
+    base = idx0 - half_sten
 
     # charges: [1, y_0..y_{m-1}] for K2; the unit charge also serves K1·1
     valid_w = (jnp.ones((nfull,), dtype) if col_valid is None
                else col_valid.astype(dtype))
     charges = jnp.concatenate([valid_w[:, None], y_full * valid_w[:, None]],
                               axis=1)  # [N, 1+m]
-    nch = 1 + m
 
-    # ---- spread: p^m scatter-adds via segment_sum over flattened cell ids
-    grid_ch = jnp.zeros((g**m, nch), dtype)
-    base = idx0 - (p - 1) // 2
+    # ---- spread: ONE segment_sum over the concatenated p^m stencil taps
+    offs_w, offs_flat = [], []
     for offs in itertools.product(range(p), repeat=m):
         w = jnp.ones((nfull,), dtype)
         flat = jnp.zeros((nfull,), jnp.int32)
         for d in range(m):
             w = w * wdim[:, d, offs[d]]
             flat = flat * g + (base[:, d] + offs[d])
-        grid_ch = grid_ch + jax.ops.segment_sum(
-            charges * w[:, None], flat, num_segments=g**m)
-    grid_ch = grid_ch.reshape((g,) * m + (nch,))
+        offs_w.append(w)
+        offs_flat.append(flat)
+    upd = jnp.concatenate([charges * w[:, None] for w in offs_w], axis=0)
+    flat_all = jnp.concatenate(offs_flat)
+    grid_ch = jax.ops.segment_sum(upd, flat_all, num_segments=g**m)
+    gridf = grid_ch.T.reshape((nch,) + (g,) * m)  # channels-first
 
-    # ---- FFT convolution with K1 and K2 on the embedded 2G circulant grid
-    coords = jnp.minimum(jnp.arange(2 * g), 2 * g - jnp.arange(2 * g)) * h
-    r2 = jnp.zeros((2 * g,) * m, dtype)
-    for d in range(m):
-        shape = [1] * m
-        shape[d] = 2 * g
-        r2 = r2 + (coords.reshape(shape)) ** 2
-    k1 = 1.0 / (1.0 + r2)
+    # ---- kernel tables from the hoisted lattice (only h changes per call)
+    k1 = 1.0 / (1.0 + (h * h) * geom.rho2)
     k2 = k1 * k1
+    axes = tuple(range(1, m + 1))
+    khat = jnp.fft.rfftn(jnp.stack([k1, k2]), axes=axes)  # [2, ..., G+1]
+    pad_widths = [(0, 0)] + [(0, g)] * m
+    gpad = jnp.pad(gridf, pad_widths)
+    ghat = jnp.fft.rfftn(gpad, axes=axes)                 # [nch, ..., G+1]
 
-    pad_widths = [(0, g)] * m + [(0, 0)]
-    gpad = jnp.pad(grid_ch, pad_widths)
-    axes = tuple(range(m))
-    ghat = jnp.fft.rfftn(gpad, axes=axes)
-    k1hat = jnp.fft.rfftn(k1, axes=axes)
-    k2hat = jnp.fft.rfftn(k2, axes=axes)
-    # channel 0 under K1 (for Z); all channels under K2 (for forces)
-    conv_z = jnp.fft.irfftn(ghat[..., 0] * k1hat, axes=axes,
-                            s=(2 * g,) * m)
-    conv_f = jnp.fft.irfftn(ghat * k2hat[..., None], axes=axes,
-                            s=(2 * g,) * m)
-    sl = tuple(slice(0, g) for _ in range(m))
-    pot_z = conv_z[sl]            # [g]*m
-    pot_f = conv_f[sl]            # [g]*m + [nch]
+    # ---- spectral Z (Parseval over the rfft half-spectrum): no inverse
+    # FFT, no gather — and a replicated, fixed-order, mesh-canonical sum.
+    # w_k doubles the columns the half-spectrum folds (1 < col < G).
+    s0 = ghat[0]
+    wcol = jnp.full((g + 1,), 2.0, dtype).at[0].set(1.0).at[g].set(1.0)
+    k1hat = khat[0].real
+    big = float((2 * g) ** m)
+    z_pairs = jnp.sum((s0.real * s0.real + s0.imag * s0.imag)
+                      * k1hat * wcol) / big
+    n_valid = s0[(0,) * m].real  # DC bin = total unit charge
+    z_global = (z_pairs - n_valid).astype(dtype)
+
+    # ---- force convolution: all charge channels under K2, one inverse
+    conv = jnp.fft.irfftn(ghat * khat[1], axes=axes, s=(2 * g,) * m)
+    sl = (slice(None),) + tuple(slice(0, g) for _ in range(m))
+    pot_f = conv[sl].reshape(nch, -1)                     # [nch, G^m]
 
     # ---- gather at the local rows
     rows = row_offset + jnp.arange(nloc)
@@ -153,22 +209,14 @@ def fft_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
     w_loc = wdim[rows]
     y_loc_w = valid_w[rows]
 
-    phi_z = jnp.zeros((nloc,), dtype)
-    phi_f = jnp.zeros((nloc, nch), dtype)
-    pot_z_flat = pot_z.reshape(-1)
-    pot_f_flat = pot_f.reshape(-1, nch)
+    phi_f = jnp.zeros((nch, nloc), dtype)
     for offs in itertools.product(range(p), repeat=m):
         w = jnp.ones((nloc,), dtype)
         flat = jnp.zeros((nloc,), jnp.int32)
         for d in range(m):
             w = w * w_loc[:, d, offs[d]]
             flat = flat * g + (b_loc[:, d] + offs[d])
-        phi_z = phi_z + w * pot_z_flat[flat]
-        phi_f = phi_f + w[:, None] * pot_f_flat[flat]
+        phi_f = phi_f + w[None, :] * pot_f[:, flat]
 
-    rep = (y[:, :] * phi_f[:, :1] - phi_f[:, 1:]) * y_loc_w[:, None]
-    # local partial Z: each local point's K1 potential minus its self-term
-    if row_z:
-        return rep, (phi_z - 1.0) * y_loc_w
-    sum_q = jnp.sum((phi_z - 1.0) * y_loc_w)
-    return rep, sum_q
+    rep = (y * phi_f[0][:, None] - phi_f[1:].T) * y_loc_w[:, None]
+    return rep, z_global

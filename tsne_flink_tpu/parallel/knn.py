@@ -62,6 +62,10 @@ def _fold_tile(best, x_rows, x_cols, row_ids, col_ids, n_global, k, metric,
         new_d, sel = _topk_smallest(cat_d, k)
         return (new_d, jnp.take_along_axis(cat_i, sel, axis=1)), None
 
+    # graftlint: disable=carry-hygiene -- loop-INVARIANT operand closures:
+    # x_rows/row_ids are the fixed query tile every column block scans
+    # against (read-only jit inputs); k/metric/nr/cb are trace statics;
+    # the running top-k (the only mutated state) IS the scan carry
     best, _ = lax.scan(merge, best, (cols_p, cids_p))
     return best
 
@@ -123,6 +127,10 @@ def ring_knn(x_local: jnp.ndarray, k: int, n_shards: int, n_global: int,
                        axis_name, to="varying"))
     # n_shards - 1 hops each fold-then-send; the final received block is
     # folded outside the loop so no shard travels the ring only to be dropped
+    # graftlint: disable=carry-hygiene -- loop-INVARIANT operand closures:
+    # fold/shift_left/axis_name are trace-time statics (the ring
+    # permutation table); the travelling block and the running top-k —
+    # everything that changes per hop — ride the carry
     best, blk = lax.fori_loop(
         0, n_shards - 1, hop, (init_best, x_local))
     best_d, best_i = fold(best, blk, n_shards - 1)

@@ -262,7 +262,11 @@ class _PersistentFn:
     Later calls run the executable directly.  Argument layouts must stay
     fixed across calls — exactly the contract of the segment/stage entry
     functions this wraps (``ShardedOptimizer`` keys ragged tails
-    separately; the kNN stage fns see one shape per prepare)."""
+    separately; the kNN stage fns see one shape per prepare; graftstep's
+    decomposed exact sweep wraps its ``sweep`` stage with a ``stage``
+    key fragment, and the optimize segments carry the resolved
+    attraction-kernel policy so a ``TSNE_ATTRACTION_KERNEL`` flip is a
+    miss, never a stale load)."""
 
     def __init__(self, jitted, key_parts: dict, label: str,
                  root: str | None = None):

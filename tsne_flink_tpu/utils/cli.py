@@ -16,7 +16,7 @@ import json
 import os
 import sys
 
-from tsne_flink_tpu.utils.env import env_bool, env_float, env_str
+from tsne_flink_tpu.utils.env import env_bool, env_float, env_int, env_str
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -82,9 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="auto: exact when theta==0 or N small, else bh/fft")
     p.add_argument("--attraction", default="auto",
                    choices=list(ATTRACTION_MODES),
-                   help="attraction layout: padded [N,S] rows or the flat "
-                        "edge list sized by the true edge count (auto: edges "
-                        "when hub rows make S >= 2x the mean degree)")
+                   help="attraction layout: padded [N,S] rows, the flat "
+                        "edge list, or the graftstep capped-width CSR "
+                        "(head [N,W] through the fused kernel + overflow "
+                        "tail — ops/attraction_pallas).  auto picks csr "
+                        "when hub rows make S >= 2x the mean degree, "
+                        "else rows")
     p.add_argument("--affinityAssembly", default=None,
                    choices=["auto", "sorted", "split", "blocks"],
                    help="symmetrized-P builder: sorted = 2-key sort + "
@@ -802,6 +805,9 @@ def _main(argv=None, sp_run=None) -> int:
                                  args.nComponents, theta_explicit),
         attraction=args.attraction,
         bh_gate=args.bhGate,
+        # graftstep opt-in repulsion amortization (env-only knob, like
+        # TSNE_ATTRACTION_KERNEL; default 1 = exact cadence)
+        repulsion_stride=env_int("TSNE_REPULSION_STRIDE"),
     )
 
     # static plan audit BEFORE any expensive stage: the whole point is

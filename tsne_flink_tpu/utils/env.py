@@ -104,6 +104,29 @@ _declare("TSNE_KNN_KERNEL", "str", "auto",
          "interpret-mode Pallas (the CPU parity-test configuration).",
          choices=("auto", "pallas", "interpret", "xla"))
 
+# ---- optimize step (graftstep) ---------------------------------------------
+_declare("TSNE_ATTRACTION_KERNEL", "str", "auto",
+         "Per-row-tile kernel of the fused attraction step "
+         "(ops/attraction_pallas.pick_attraction_kernel). 'auto' runs the "
+         "Pallas kernel on TPU (Mosaic lowering probe, XLA fallback) and "
+         "the XLA norm-trick einsum twin elsewhere; 'interpret' forces "
+         "interpret-mode Pallas (the CPU parity-test configuration).",
+         choices=("auto", "pallas", "interpret", "xla"))
+_declare("TSNE_ATTRACTION_WIDTH", "int", 0,
+         "Head width W of the capped-width CSR attraction layout "
+         "(ops/attraction_pallas.pick_csr_width). 0 = the policy default "
+         "(~1.3x the global mean symmetrized degree, 64-lane rounded); "
+         "set explicitly only for A/B evidence runs — W is a recorded "
+         "GLOBAL quantity so every mesh width must agree on it.")
+_declare("TSNE_REPULSION_STRIDE", "int", 1,
+         "graftstep opt-in repulsion amortization: recompute the "
+         "repulsion field every Nth iteration and carry (rep, Z) in the "
+         "optimize loop between refreshes (models/tsne.optimize). 1 "
+         "(default) is the exact every-iteration cadence — the carried "
+         "buffers do not exist and the program is bit-identical to the "
+         "unstrided one. >1 is an approximation; it rides every bench "
+         "record as 'repulsion_stride'.")
+
 # ---- runtime resilience (tsne_flink_tpu/runtime/) --------------------------
 _declare("TSNE_FAULT_PLAN", "str", None,
          "Deterministic fault-injection plan (runtime/faults.py), "

@@ -259,13 +259,15 @@ def affinity_flops(n: int, k: int, steps: int = 50) -> float:
 
 def attraction_flops_per_iter(n: int, s: int, m: int,
                               nnz_pairs: float | None = None) -> float:
-    """F_attr (models/tsne.py:_attractive_forces): per (i,j) pair — sqdist
-    (3m), Student-t kernel (~2), P*q weight + row sums (~3), force
-    accumulation (2m), loss term (~4) => ~5m+9 ops over the launched pairs:
-    n*s for the padded row layout, or the (padded) true edge count when the
-    edge layout runs (models/tsne.py:_attractive_forces_edges)."""
+    """F_attr (models/tsne.py attraction dispatch): per (i,j) pair —
+    sqdist (3m), Student-t kernel (~2), P*q weight + row sums (~3), force
+    accumulation (2m) => ~5m+5 ops every iteration over the launched
+    pairs (n*s for the padded row layout, or the launched head+tail pair
+    count for the csr/edge layouts), PLUS the KL term (~4 ops/pair) which
+    graftstep gates to the loss-report interval — amortized 4/LOSS_EVERY
+    per iteration."""
     pairs = float(n) * s if nnz_pairs is None else float(nnz_pairs)
-    return pairs * (5.0 * m + 9.0)
+    return pairs * (5.0 * m + 5.0 + 4.0 / 10.0)
 
 
 def repulsion_flops_per_iter(n: int, m: int, backend: str, *,
@@ -285,8 +287,10 @@ def repulsion_flops_per_iter(n: int, m: int, backend: str, *,
       default_levels() so the model tracks the launched depth caps.
     * fft: spread + gather are p^m stencil taps over (1+m) charge channels
       (~m weight mults + 2*(1+m) madds each); the circulant convolution is
-      2*nch+3 real FFTs of M=(2G)^m points at 2.5*M*log2(M) each, plus ~6*M
-      pointwise complex mults per channel (ops/repulsion_fft.py).
+      2 kernel + nch forward + nch inverse real FFTs of M=(2G)^m points at
+      2.5*M*log2(M) each (graftstep: the Z potential is summed spectrally
+      — Parseval — so its inverse FFT is gone), plus ~6*M pointwise
+      complex mults per channel (ops/repulsion_fft.py).
     """
     if backend == "exact":
         w = mpad if mpad is not None else max(m, 8)
@@ -307,7 +311,7 @@ def repulsion_flops_per_iter(n: int, m: int, backend: str, *,
         taps = interp ** m
         spread_gather = 2.0 * n * taps * (m + 2.0 * nch)
         big = float((2 * g) ** m)
-        ffts = (2 * nch + 3) * 2.5 * big * math.log2(big)
+        ffts = (2 * nch + 2) * 2.5 * big * math.log2(big)
         pointwise = 6.0 * big * nch
         return spread_gather + ffts + pointwise
     raise ValueError(f"unknown repulsion backend '{backend}'")
