@@ -80,6 +80,7 @@ RECORD_BASE_KEYS = (
     "peak_flops", "peak_flops_basis", "assembly", "cache", "matmul_dtype",
     "knn_tiles", "audit", "degradations", "aot_cache", "memory",
     "host_calib", "fleet", "mesh", "kl", "repulsion_stride",
+    "effective_seconds_per_iter", "repulsion_refreshes", "policy",
 )
 
 
@@ -266,10 +267,15 @@ def main():
                                             init_working_set)
     from tsne_flink_tpu.parallel.mesh import MeshPlan, ShardedOptimizer
 
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
-    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 300
-    repulsion = sys.argv[3] if len(sys.argv) > 3 else "auto"
-    attraction = sys.argv[4] if len(sys.argv) > 4 else "auto"
+    # flags ride alongside the positionals (the retry wrapper forwards
+    # argv verbatim); --autopilot arms graftpilot exactly like the env
+    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
+    autopilot_on = ("--autopilot" in sys.argv[1:]
+                    or env_bool("TSNE_AUTOPILOT"))
+    n = int(argv[0]) if len(argv) > 0 else 60_000
+    iters = int(argv[1]) if len(argv) > 1 else 300
+    repulsion = argv[2] if len(argv) > 2 else "auto"
+    attraction = argv[3] if len(argv) > 3 else "auto"
     from tsne_flink_tpu.models.tsne import REPULSION_CHOICES
     from tsne_flink_tpu.ops.affinities import ATTRACTION_MODES
     if attraction not in ATTRACTION_MODES:
@@ -338,7 +344,9 @@ def main():
     cfg = TsneConfig(iterations=iters, perplexity=30.0, theta=theta,
                      repulsion=repulsion, attraction=attraction,
                      row_chunk=4096,
-                     repulsion_stride=env_int("TSNE_REPULSION_STRIDE"))
+                     repulsion_stride=env_int("TSNE_REPULSION_STRIDE"),
+                     autopilot=autopilot_on)
+    from tsne_flink_tpu.models import autopilot as pilot_mod
     k = 90  # 3 * perplexity (Tsne.scala:55)
     # the same auto kNN policy the CLI runs, resolved up front so the
     # record, the FLOP model and the fingerprint all key the method that
@@ -429,7 +437,8 @@ def main():
                        knn_refine=refine, repulsion=repulsion,
                        theta=theta, assembly=assembly,
                        attraction=attraction, row_chunk=cfg.row_chunk,
-                       mesh=mesh_count, name="bench")
+                       mesh=mesh_count, autopilot=autopilot_on,
+                       name="bench")
     _hbm = plan_hbm_report(_plan)
     audit_rec = {"peak_hbm_est": _hbm["peak_hbm_est"],
                  "peak_stage": _hbm["peak_stage"],
@@ -532,6 +541,15 @@ def main():
         # graftstep opt-in repulsion amortization cadence (1 = exact
         # every-iteration recomputation, the default)
         "repulsion_stride": cfg.repulsion_stride,
+        # graftpilot (ISSUE 12 satellite): measured optimize rate +
+        # actual repulsion-field evaluations, None until the first
+        # optimize boundary lands; "policy" is the full decision record
+        # (models/autopilot.policy_report) — present on EVERY record,
+        # static schedule reported when the autopilot is off
+        "effective_seconds_per_iter": None,
+        "repulsion_refreshes": pilot_mod.policy_report(
+            cfg, None, iterations_run=0)["repulsion_refreshes"],
+        "policy": pilot_mod.policy_report(cfg, None, iterations_run=0),
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
@@ -681,6 +699,30 @@ def main():
                                      else 0.0))  # warm prepare: no rate
         return t_knn + t_aff + opt_elapsed() * iters / it_done
 
+    _seen_transitions = {"n": 0}
+
+    def _policy_update(it_done, opt_seconds):
+        """Refresh the graftpilot satellite keys on ``base`` so EVERY
+        superseding emission carries the measured per-iter rate, the
+        actual refresh count and the live decision record; each NEW
+        stride/grid transition also lands as an obs instant."""
+        pol = pilot_mod.policy_report(
+            cfg, sup.last_pilot if autopilot_on else None,
+            iterations_run=it_done)
+        base["policy"] = pol
+        base["repulsion_refreshes"] = pol["repulsion_refreshes"]
+        base["effective_seconds_per_iter"] = (
+            round(opt_seconds / it_done, 4) if it_done else None)
+        for tr in pol["transitions"][_seen_transitions["n"]:]:
+            obtrace.instant("autopilot.transition", cat="optimize",
+                            it=tr["iter"], trigger=tr["trigger"],
+                            stride_from=tr["stride"][0],
+                            stride_to=tr["stride"][1],
+                            grid_from=tr["grid_level"][0],
+                            grid_to=tr["grid_level"][1],
+                            grad_norm=tr["grad_norm"])
+        _seen_transitions["n"] = len(pol["transitions"])
+
     def cb(state_u, next_iter, losses):
         jax.block_until_ready(state_u.y)
         now = opt_elapsed()  # span-sourced segment timing
@@ -692,6 +734,7 @@ def main():
             # latest recorded KL rides every superseding record
             base["kl"] = round(
                 float(losses[min(slot, losses.shape[0] - 1)]), 4)
+        _policy_update(next_iter, now)
         measured = t_knn + t_aff + now
         emit_partial(measured, est_total_at(next_iter),
                      {"knn": t_knn, "affinities": t_aff,
@@ -720,6 +763,7 @@ def main():
     t_opt = sp_opt.end().seconds
     compile_mark("optimize")
     mem_mark("optimize")
+    _policy_update(it_done, t_opt)
 
     complete = it_done == iters
     total = (t_knn + t_aff + t_opt if complete

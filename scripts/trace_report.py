@@ -18,10 +18,16 @@ and renders its predicted-vs-observed memory block as a per-stage table
 whose drift exceeds :data:`DRIFT_WARN` — the terminal face of the
 graftcheck HBM model's feedback loop.
 
+``--policy`` (graftpilot satellite): reads a bench record and renders its
+``policy`` block — the autopilot's decision transitions (iteration,
+trigger, old -> new stride and grid level, grad-norm at decision) plus
+the ladder identities and refresh count — the terminal face of the
+models/autopilot.py policy trace.
+
 ``--smoke`` (tier-1, tests/test_obs.py): generates a tiny in-process
 trace with the real tracer, writes it to a temp file, and reports on it —
-plus a synthetic memory table — proving the emit -> load -> aggregate
-loop end to end without JAX.
+plus a synthetic memory table and a synthetic policy table — proving the
+emit -> load -> aggregate loop end to end without JAX.
 """
 
 from __future__ import annotations
@@ -197,6 +203,61 @@ def render_memory(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def policy_summary(rec: dict) -> dict:
+    """Normalized rows from a record's graftpilot ``policy`` block:
+    {"autopilot", "ladders": {...}, "rows": [{iter, trigger, stride,
+    grid, grad_norm}], "refreshes", "effective_seconds_per_iter",
+    "final_stride"}."""
+    pol = rec.get("policy") or {}
+    rows = []
+    for tr in pol.get("transitions", []):
+        s0, s1 = tr.get("stride", [None, None])
+        g0, g1 = tr.get("grid_level", [None, None])
+        rows.append({"iter": tr.get("iter"), "trigger": tr.get("trigger"),
+                     "stride": f"{s0}->{s1}", "grid": f"{g0}->{g1}",
+                     "grad_norm": tr.get("grad_norm")})
+    return {"autopilot": pol.get("autopilot"),
+            "ladders": {"stride": pol.get("stride_ladder"),
+                        "grid": pol.get("grid_ladder"),
+                        "tail_start": pol.get("tail_start"),
+                        "decide_every": pol.get("decide_every"),
+                        "kl_guardrail_tol": pol.get("kl_guardrail_tol")},
+            "rows": rows,
+            "refreshes": (rec.get("repulsion_refreshes")
+                          if rec.get("repulsion_refreshes") is not None
+                          else pol.get("repulsion_refreshes")),
+            "effective_seconds_per_iter":
+                rec.get("effective_seconds_per_iter"),
+            "final_stride": pol.get("final_stride")}
+
+
+def render_policy(summary: dict) -> str:
+    if summary["autopilot"] is None:
+        return "trace_report: record carries no policy block"
+    lad = summary["ladders"]
+    lines = [f"policy (autopilot {'on' if summary['autopilot'] else 'off'}): "
+             f"stride ladder {lad['stride']}, grid ladder {lad['grid']}, "
+             f"decide every {lad['decide_every']} iters, "
+             f"tail at {lad['tail_start']}, "
+             f"KL guardrail {lad['kl_guardrail_tol']}"]
+    if summary["rows"]:
+        lines.append(f"{'iter':>6} {'trigger':<15} {'stride':>8} "
+                     f"{'grid':>6} {'grad_norm':>12}")
+        for r in summary["rows"]:
+            gn = ("-" if r["grad_norm"] is None
+                  else f"{r['grad_norm']:.6g}")
+            lines.append(f"{r['iter']:>6} {r['trigger']:<15} "
+                         f"{r['stride']:>8} {r['grid']:>6} {gn:>12}")
+    else:
+        lines.append("no transitions (static schedule)")
+    eff = summary["effective_seconds_per_iter"]
+    lines.append(
+        f"refreshes: {summary['refreshes']}, "
+        f"final stride: {summary['final_stride']}, "
+        f"effective s/iter: {'-' if eff is None else eff}")
+    return "\n".join(lines)
+
+
 def _smoke(out_json: bool) -> int:
     """Emit a real (tiny) trace through the tracer and report on it —
     the tier-1 pin that the whole export/report loop works, JAX-free."""
@@ -236,18 +297,44 @@ def _smoke(out_json: bool) -> int:
     mem_ok = (len(msum["rows"]) == 2 and len(msum["warnings"]) == 1
               and any(r["warn"] and r["stage"] == "optimize"
                       for r in msum["rows"]))
+    # the --policy path, end to end on a synthetic graftpilot record:
+    # one raise, one tail collapse, one phase grid switch
+    prec = {"effective_seconds_per_iter": 0.19, "repulsion_refreshes": 190,
+            "policy": {"autopilot": True, "stride_ladder": [1, 2, 4, 8],
+                       "grid_ladder": [512, 1024], "kl_guardrail_tol": 0.05,
+                       "smooth_rel": 0.15, "rough_rel": 0.4,
+                       "tail_start": 270, "decide_every": 10,
+                       "transitions": [
+                           {"iter": 20, "trigger": "raise",
+                            "stride": [1, 2], "grid_level": [0, 0],
+                            "grad_norm": 0.81},
+                           {"iter": 50, "trigger": "phase",
+                            "stride": [2, 2], "grid_level": [0, 1],
+                            "grad_norm": 0.52},
+                           {"iter": 270, "trigger": "collapse-tail",
+                            "stride": [2, 1], "grid_level": [1, 1],
+                            "grad_norm": 0.07}],
+                       "repulsion_refreshes": 190, "final_stride": 1}}
+    psum = policy_summary(prec)
+    pol_ok = (psum["autopilot"] is True and len(psum["rows"]) == 3
+              and psum["rows"][0]["stride"] == "1->2"
+              and psum["rows"][1]["grid"] == "0->1"
+              and psum["refreshes"] == 190)
     ok = (summary["spans"].get("optimize.segment", {}).get("count") == 2
           and "prepare.knn" in summary["spans"]
           and summary["instants"].get("supervisor.oom") == 1
-          and mem_ok)
+          and mem_ok and pol_ok)
     if out_json:
         print(json.dumps({"ok": ok, "summary": {
             "spans": summary["spans"], "instants": summary["instants"],
-            "segments": summary["segments"]}, "memory": msum}))
+            "segments": summary["segments"]}, "memory": msum,
+            "policy": psum}))
     else:
         print(render(summary))
         print()
         print(render_memory(msum))
+        print()
+        print(render_policy(psum))
         print(f"\nsmoke: {'ok' if ok else 'FAILED'}")
     return 0 if ok else 1
 
@@ -266,6 +353,11 @@ def main(argv=None) -> int:
                     help="render the predicted/observed/drift memory "
                          "table of a bench record JSON (warns on drift "
                          f"> {DRIFT_WARN}x)")
+    ap.add_argument("--policy", metavar="RECORD",
+                    help="render the graftpilot policy block of a bench "
+                         "record JSON: stride/grid transitions (iter, "
+                         "trigger, old->new, grad-norm at decision), "
+                         "refresh count and effective s/iter")
     args = ap.parse_args(argv)
     if args.smoke:
         return _smoke(args.json)
@@ -276,8 +368,16 @@ def main(argv=None) -> int:
         else:
             print(render_memory(msum))
         return 0
+    if args.policy:
+        psum = policy_summary(load_record(args.policy))
+        if args.json:
+            print(json.dumps(psum))
+        else:
+            print(render_policy(psum))
+        return 0
     if not args.trace:
-        ap.error("a trace file is required (or --smoke / --memory)")
+        ap.error("a trace file is required (or --smoke / --memory / "
+                 "--policy)")
     summary = summarize(load_events(args.trace))
     if args.json:
         print(json.dumps(summary))

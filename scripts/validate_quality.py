@@ -10,6 +10,7 @@ independent yardstick.  Compares, on the same blobs dataset:
 
 Usage: python scripts/validate_quality.py [n] [dim] [repulsion] [knn_method]
        python scripts/validate_quality.py --digits [repulsion]
+       python scripts/validate_quality.py --autopilot [n] [iters]
        ... [--dtype bfloat16]
 
 --digits runs on sklearn's bundled handwritten-digits set (1797 x 64) — a
@@ -19,6 +20,14 @@ blobs (VERDICT r2 next-step #7).
 --dtype runs OUR optimizer in that dtype (the CLI's --dtype; bfloat16 is the
 MXU-native 2x path) while sklearn stays f64 — the KL/trustworthiness deltas
 vs our f32 row are the bf16 quality evidence (VERDICT r3 next-step #7).
+
+--autopilot is the graftpilot quality guardrail (models/autopilot.py):
+the SAME blobs run twice through OUR optimizer — the exact oracle
+(repulsion=exact, autopilot off) against the FFT path with the autopilot
+armed — and the final-KL gap is checked against KL_GUARDRAIL_TOL, the
+tolerance the bench gate pins.  Both runs share the kNN-sparse affinity
+support, so unlike the sklearn rows these KLs ARE directly comparable.
+Committed evidence: results/quality_autopilot_r12.txt.
 """
 
 import os
@@ -39,6 +48,48 @@ from tsne_flink_tpu.utils.env import env_str
 jax.config.update("jax_platforms", env_str("TSNE_QUALITY_BACKEND"))
 
 
+def autopilot_row(n: int = 10_000, iters: int = 500) -> int:
+    """Final KL + trustworthiness: FFT-with-autopilot vs the exact oracle
+    on the same blobs, gap gated at ``KL_GUARDRAIL_TOL``."""
+    from sklearn.manifold import trustworthiness
+
+    from tsne_flink_tpu import TSNE
+    from tsne_flink_tpu.models.autopilot import KL_GUARDRAIL_TOL
+
+    d = 50
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(8, d)) * 6.0
+    labels = rng.integers(0, 8, n)
+    x = (centers[labels] + rng.normal(size=(n, d))).astype(np.float32)
+
+    rows = []
+    for name, kw in (("exact oracle", dict(repulsion="exact")),
+                     ("fft+autopilot", dict(repulsion="fft",
+                                            autopilot=True))):
+        t0 = time.time()
+        est = TSNE(perplexity=30.0, n_iter=iters, random_state=0,
+                   knn_method="bruteforce", **kw)
+        y = est.fit_transform(x).astype(np.float64)
+        rows.append((name, est.kl_divergence_,
+                     trustworthiness(x, y, n_neighbors=12),
+                     time.time() - t0,
+                     est.metrics_.get("policy")))
+
+    gap = rows[1][1] - rows[0][1]
+    ok = gap <= KL_GUARDRAIL_TOL
+    print(f"blobs n={n} d={d} iters={iters} — autopilot KL guardrail")
+    for name, kl, tw, secs, _ in rows:
+        print(f"{name:14s}: KL={kl:.4f}  trustworthiness={tw:.4f}"
+              f"  ({secs:.1f}s)")
+    pol = rows[1][4] or {}
+    print(f"policy        : refreshes={pol.get('repulsion_refreshes')}"
+          f"/{iters}  final_stride={pol.get('final_stride')}  "
+          f"transitions={len(pol.get('transitions', []))}")
+    print(f"KL gap        : {gap:+.4f} vs guardrail tol "
+          f"{KL_GUARDRAIL_TOL} -> {'OK' if ok else 'EXCEEDED'}")
+    return 0 if ok else 1
+
+
 def main():
     dtype = None
     argv = list(sys.argv)
@@ -46,6 +97,11 @@ def main():
         i = argv.index("--dtype")
         dtype = argv[i + 1]
         del sys.argv[i:i + 2]
+    if "--autopilot" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n = int(args[0]) if args else 10_000
+        iters = int(args[1]) if len(args) > 1 else 500
+        sys.exit(autopilot_row(n, iters))
     if "--digits" in sys.argv:
         from sklearn.datasets import load_digits
         x = load_digits().data.astype(np.float32)

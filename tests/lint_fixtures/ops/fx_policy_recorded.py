@@ -1,0 +1,44 @@
+"""Seeded policy-recorded violations (exercised by tests/test_lint.py).
+
+``pick_*`` resolvers in ops//models//utils/ must name, in double
+backticks, the bench-record key their resolved choice lands in — or
+carry a rationale'd suppression.  Stamped resolvers, non-``pick_``
+helpers and suppressed twins must stay silent.
+"""
+
+
+def pick_mystery_method(n):  # VIOLATION: no docstring at all
+    return "exact" if n < 1000 else "approx"
+
+
+def pick_undocumented_width(d):  # VIOLATION: docstring names no record key
+    """Auto projection width: 32 above 128 dims, else full width."""
+    return 32 if d > 128 else None
+
+
+def pick_fake_stamped(n):  # VIOLATION: ``not_a_record_key`` is not a key
+    """Resolves the frobnication order; recorded as ``not_a_record_key``."""
+    return n % 3
+
+
+def pick_stamped_method(n):
+    """Auto method policy; the resolved value lands on every bench record
+    as ``knn_method``."""
+    return "bruteforce" if n < 100_000 else "project"
+
+
+def pick_extra_key_stamped(backend):
+    """Kernel policy; what actually ran is recorded as
+    ``attraction_kernel`` on the final record."""
+    return "xla" if backend != "tpu" else "pallas"
+
+
+def helper_not_a_policy(n):
+    # not pick_*-named: out of scope, silent
+    return n * 2
+
+
+# graftlint: disable=policy-recorded -- seeded suppression twin: output is
+# a pure function of n, which the record pins
+def pick_suppressed(n):
+    return n // 2

@@ -1,0 +1,258 @@
+"""graftpilot contracts (ISSUE 12 tentpole).
+
+Four layers:
+
+* the CONTROLLER DECIDES correctly: unit coverage of every
+  ``pilot_update`` trigger (warmup / raise / hold / collapse-rough /
+  collapse-tail) and the off-report freeze;
+* OFF IS FREE: with ``autopilot=False`` no controller entry point is
+  even reachable (monkeypatch-to-boom), so the program is today's, bit
+  for bit — the same contract ``with_health``/``with_telemetry`` pin;
+* DECISIONS ARE DETERMINISTIC: mesh 1 == mesh 8 bit-identical through
+  the carried controller state, segmented == full when the boundaries
+  land on ladder multiples, and a checkpoint-FILE resume mid-schedule
+  (``pilot_carry`` via utils/checkpoint) reproduces the decision
+  sequence and the final embedding exactly;
+* the POLICY IS REPORTED: ``policy_report`` renders the live trace into
+  the transitions the bench record and trace_report --policy show, and
+  the static (autopilot-off) block is never absent.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tsne_flink_tpu.models import autopilot as ap
+from tsne_flink_tpu.models.tsne import (LOSS_EVERY, TsneConfig, TsneState,
+                                        optimize)
+from tsne_flink_tpu.ops.affinities import (joint_distribution,
+                                           pairwise_affinities)
+from tsne_flink_tpu.ops.knn import knn_bruteforce
+from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+from tsne_flink_tpu.utils import checkpoint as ckpt
+
+
+def problem(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, 6)) * 4.0
+    x = centers[rng.integers(0, 3, n)] + rng.normal(size=(n, 6))
+    idx, dist = knn_bruteforce(jnp.asarray(x), 8)
+    p = pairwise_affinities(dist, 4.0)
+    jidx, jval = joint_distribution(idx, p)
+    y0 = rng.normal(size=(n, 2)) * 1e-4
+    st = TsneState(y=jnp.asarray(y0),
+                   update=jnp.zeros_like(jnp.asarray(y0)),
+                   gains=jnp.ones_like(jnp.asarray(y0)))
+    return st, jidx, jval
+
+
+#: 60-iteration fft schedule: early exaggeration spans the whole run
+#: (exaggeration_end == iterations), so the grid ladder's phase boundary
+#: sits exactly at the end — one recorded "phase" transition — while the
+#: stride controller gets 6 report slots to climb and collapse in.
+CFG = TsneConfig(iterations=60, repulsion="fft", fft_grid=64,
+                 row_chunk=16, autopilot=True)
+
+
+# ---- the controller decides correctly --------------------------------------
+
+def _step(i, gn, pvec, trace, cfg, record=True, refreshed=True):
+    return ap.pilot_update(jnp.asarray(i), jnp.asarray(gn, trace.dtype),
+                           pvec, trace, jnp.asarray(refreshed),
+                           jnp.asarray(i // LOSS_EVERY, jnp.int32),
+                           jnp.asarray(record), cfg)
+
+
+def test_pilot_update_triggers():
+    cfg = TsneConfig(iterations=200, repulsion="fft", autopilot=True)
+    dt = jnp.float64
+    pvec = ap.pilot_init(cfg, dt)
+    trace = ap.trace_init(cfg, dt)
+
+    # warmup: no history -> hold level 0, trigger code 4, history primed
+    pvec, trace = _step(9, 1.0, pvec, trace, cfg)
+    assert int(pvec[0]) == 0 and float(pvec[1]) == 1.0
+    assert int(trace[0][3]) == ap.PILOT_TRIGGERS.index("warmup")
+
+    # smooth trend (rel 0.05 < SMOOTH_REL) -> climb one rung
+    pvec, trace = _step(19, 1.05, pvec, trace, cfg)
+    assert int(pvec[0]) == 1
+    assert int(ap.stride_of(pvec)) == ap.STRIDE_LADDER[1]
+    assert int(trace[1][3]) == ap.PILOT_TRIGGERS.index("raise")
+
+    # hysteresis band (SMOOTH_REL < rel < ROUGH_REL) -> hold
+    pvec, trace = _step(29, 1.05 * 1.3, pvec, trace, cfg)
+    assert int(pvec[0]) == 1
+    assert int(trace[2][3]) == ap.PILOT_TRIGGERS.index("hold")
+
+    # rough trend (rel > ROUGH_REL) -> collapse to stride 1
+    pvec, trace = _step(39, 10.0, pvec, trace, cfg)
+    assert int(pvec[0]) == 0
+    assert int(trace[3][3]) == ap.PILOT_TRIGGERS.index("collapse-rough")
+
+    # convergence tail (final 20%) -> collapse and pin, whatever the trend
+    assert ap.tail_start(cfg) == 160
+    pvec = pvec.at[0].set(3.0)
+    pvec, trace = _step(179, 10.05, pvec, trace, cfg)
+    assert int(pvec[0]) == 0
+    assert int(trace[17][3]) == ap.PILOT_TRIGGERS.index("collapse-tail")
+
+    # off-report iterations freeze the controller but meter refreshes
+    before = np.asarray(pvec)
+    pvec, trace = _step(181, 99.0, pvec, trace, cfg, record=False)
+    after = np.asarray(pvec)
+    assert after[0] == before[0] and after[1] == before[1]
+    assert after[2] == before[2] + 1.0
+
+    # the slot crossing the exaggeration boundary (cfg.exaggeration_end
+    # = 101 here) re-primes instead of collapsing: gn_prev was measured
+    # under exaggerated P, so the ~4x drop is a rescale, not roughness
+    pvec2 = ap.pilot_init(cfg, dt).at[0].set(2.0).at[1].set(1.0)
+    trace2 = ap.trace_init(cfg, dt)
+    pvec2, trace2 = _step(109, 0.25, pvec2, trace2, cfg)
+    assert int(pvec2[0]) == 2 and float(pvec2[1]) == 0.25
+    assert int(trace2[10][3]) == ap.PILOT_TRIGGERS.index("warmup")
+
+
+def test_autopilot_rejects_static_stride():
+    st, jidx, jval = problem()
+    cfg = TsneConfig(iterations=20, repulsion="exact", row_chunk=16,
+                     autopilot=True, repulsion_stride=2)
+    with pytest.raises(ValueError, match="one approximation policy"):
+        optimize(st, jidx, jval, cfg)
+
+
+# ---- off is free ------------------------------------------------------------
+
+def test_off_path_never_reaches_the_controller(monkeypatch):
+    """autopilot=False must not even touch models/autopilot.py: every
+    entry point explodes, and the run still succeeds — the static face
+    of the off-is-bit-identical contract."""
+    def boom(*a, **k):
+        raise AssertionError("controller reached with autopilot off")
+
+    for name in ("pilot_init", "trace_init", "pilot_update", "stride_of",
+                 "grid_phase", "grid_ladder", "pilot_collapse"):
+        monkeypatch.setattr(ap, name, boom)
+    st, jidx, jval = problem()
+    cfg = TsneConfig(iterations=20, repulsion="fft", fft_grid=64,
+                     row_chunk=16)
+    out, losses = ShardedOptimizer(cfg, 48, n_devices=1)(st, jidx, jval)
+    assert np.isfinite(np.asarray(out.y)).all()
+    # ... and an armed run DOES reach it (the monkeypatch proves the
+    # probe itself is live, not vacuous)
+    with pytest.raises(AssertionError, match="controller reached"):
+        ShardedOptimizer(CFG, 48, n_devices=1)(st, jidx, jval)
+
+
+# ---- decisions are deterministic -------------------------------------------
+
+def test_mesh_width_bit_identity_through_controller_state():
+    st, jidx, jval = problem()
+    runs = {}
+    for nd in (1, 8):
+        runner = ShardedOptimizer(CFG, 48, n_devices=nd)
+        y, losses = runner(st, jidx, jval)
+        runs[nd] = (np.asarray(y.y), np.asarray(losses),
+                    np.asarray(runner.pilot_[0]),
+                    np.asarray(runner.pilot_[1]))
+    for a, b in zip(runs[1], runs[8]):
+        np.testing.assert_array_equal(a, b)
+    # the run actually exercised the policy: repulsion was refreshed
+    # fewer times than iterations (some stride rung was earned)
+    pvec = runs[1][2]
+    assert 0 < pvec[2] <= CFG.iterations
+
+
+def test_checkpoint_file_resume_reproduces_decisions(tmp_path):
+    """Kill-after-boundary resume from the FILE: the pilot carry rides
+    utils/checkpoint (inside the content hash), and the resumed run's
+    final embedding, loss trace, controller state and policy trace are
+    bit-identical to the uninterrupted run.  The boundary (40) is a
+    multiple of every ladder stride, so the segmented run is also
+    bit-identical to the full one."""
+    st, jidx, jval = problem()
+    full = ShardedOptimizer(CFG, 48, n_devices=1)
+    full_state, full_losses = full(st, jidx, jval)
+
+    saved = {}
+    seg = ShardedOptimizer(CFG, 48, n_devices=1)
+
+    def cb(s, it, losses):
+        path = os.path.join(str(tmp_path), f"b{it}.npz")
+        ckpt.save(path, s, it, np.asarray(losses), pilot=seg.pilot_)
+        saved[it] = path
+
+    seg_state, seg_losses = seg(st, jidx, jval, checkpoint_every=40,
+                                checkpoint_cb=cb)
+    assert sorted(saved) == [40]
+    np.testing.assert_array_equal(np.asarray(seg_state.y),
+                                  np.asarray(full_state.y))
+    np.testing.assert_array_equal(np.asarray(seg.pilot_[0]),
+                                  np.asarray(full.pilot_[0]))
+    np.testing.assert_array_equal(np.asarray(seg.pilot_[1]),
+                                  np.asarray(full.pilot_[1]))
+
+    st_np, next_iter, loss_carry = ckpt.load(saved[40])
+    pilot = ckpt.load_pilot(saved[40])
+    assert pilot is not None
+    resumed = TsneState(y=jnp.asarray(st_np.y),
+                        update=jnp.asarray(st_np.update),
+                        gains=jnp.asarray(st_np.gains))
+    res = ShardedOptimizer(CFG, 48, n_devices=1)
+    res_state, res_losses = res(resumed, jidx, jval, start_iter=next_iter,
+                                loss_carry=loss_carry, pilot_carry=pilot)
+    np.testing.assert_array_equal(np.asarray(res_state.y),
+                                  np.asarray(full_state.y))
+    np.testing.assert_array_equal(np.asarray(res_losses),
+                                  np.asarray(full_losses))
+    np.testing.assert_array_equal(np.asarray(res.pilot_[0]),
+                                  np.asarray(full.pilot_[0]))
+    np.testing.assert_array_equal(np.asarray(res.pilot_[1]),
+                                  np.asarray(full.pilot_[1]))
+    # pre-graftpilot files answer None (back-compat)
+    legacy = os.path.join(str(tmp_path), "legacy.npz")
+    ckpt.save(legacy, full_state, 40, np.asarray(full_losses))
+    assert ckpt.load_pilot(legacy) is None
+
+
+# ---- the policy is reported -------------------------------------------------
+
+def test_policy_report_static_block():
+    cfg = TsneConfig(iterations=300, repulsion="fft")
+    pol = ap.policy_report(cfg, None)
+    assert pol["autopilot"] is False
+    assert pol["transitions"] == [] and pol["final_stride"] == 1
+    assert pol["repulsion_refreshes"] == 300
+    assert pol["kl_guardrail_tol"] == ap.KL_GUARDRAIL_TOL
+    # a static stride reports its own honest schedule
+    strided = TsneConfig(iterations=300, repulsion="fft",
+                         repulsion_stride=4)
+    assert ap.policy_report(strided, None)["repulsion_refreshes"] == 75
+    assert ap.policy_report(strided, None,
+                            iterations_run=0)["repulsion_refreshes"] == 0
+
+
+def test_policy_report_live_transitions():
+    st, jidx, jval = problem()
+    runner = ShardedOptimizer(CFG, 48, n_devices=1)
+    runner(st, jidx, jval)
+    pol = ap.policy_report(CFG, runner.pilot_)
+    assert pol["autopilot"] is True
+    assert pol["grid_ladder"] == [32, 64]
+    assert pol["repulsion_refreshes"] == int(runner.pilot_[0][2])
+    trans = pol["transitions"]
+    assert trans, "a 60-iteration run must record at least one decision"
+    for t in trans:
+        assert t["iter"] % LOSS_EVERY == 0
+        assert t["trigger"] in ap.PILOT_TRIGGERS + ("phase",)
+        assert t["stride"][0] in ap.STRIDE_LADDER
+        assert t["stride"][1] in ap.STRIDE_LADDER
+    # the phase boundary (exaggeration_end == iterations here) lands in
+    # the final slot: the trace's last row is the fine grid
+    assert int(np.asarray(runner.pilot_[1])[-1][1]) == 1
+    # the tail pins stride 1, so the run ends exact
+    assert pol["final_stride"] == 1

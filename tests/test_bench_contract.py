@@ -30,8 +30,14 @@ REQUIRED = {"metric", "value", "unit", "vs_baseline", "backend", "data",
                       # mesh the optimize loop sharded over
             "kl",     # graftstep: latest recorded KL on EVERY record
                       # (None until the first report slot lands)
-            "repulsion_stride"}  # graftstep: the opt-in amortization
+            "repulsion_stride",  # graftstep: the opt-in amortization
                                  # cadence (1 = exact default)
+            "effective_seconds_per_iter",  # graftpilot: optimize seconds
+                                           # per iteration actually run
+            "repulsion_refreshes",  # graftpilot: actual repulsion
+                                    # evaluations (== iters when static)
+            "policy"}  # graftpilot: the resolved approximation policy +
+                       # its decision trace (static schedule when off)
 
 
 def run_bench(n, iters, extra_env=None, timeout=600):
@@ -50,7 +56,8 @@ def run_bench(n, iters, extra_env=None, timeout=600):
                  "TSNE_BENCH_MARGIN_S", "TSNE_BENCH_SEG",
                  "TSNE_ARTIFACT_DIR", "TSNE_AFFINITY_ASSEMBLY",
                  "TSNE_TUNNEL_DOWN", "TSNE_KNN_AUTOTUNE",
-                 "TSNE_TELEMETRY", "TSNE_FLEET_JOB", "TSNE_MESH"):
+                 "TSNE_TELEMETRY", "TSNE_FLEET_JOB", "TSNE_MESH",
+                 "TSNE_AUTOPILOT", "TSNE_REPULSION_STRIDE"):
         env.pop(knob, None)
     env.update(extra_env or {})
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
@@ -87,7 +94,9 @@ def test_every_line_is_a_complete_record():
 
 
 DRIFT_GATE = 3.0
-COMMITTED_RECORDS = ["bench_60k_fft_cpu_r10_step.json"]
+COMMITTED_RECORDS = ["bench_60k_fft_cpu_r10_step.json",
+                     "bench_60k_fft_cpu_r12_off.json",
+                     "bench_60k_fft_cpu_r12_autopilot.json"]
 
 
 @pytest.mark.parametrize("name", COMMITTED_RECORDS)
@@ -294,3 +303,79 @@ def test_warm_cache_run_is_labeled_and_fast(tmp_path):
     cold_prep = cold["stages"]["knn"] + cold["stages"]["affinities"]
     warm_prep = warm["stages"]["knn"] + warm["stages"]["affinities"]
     assert warm_prep < max(0.5 * cold_prep, 1.0), (warm_prep, cold_prep)
+
+
+def test_autopilot_bench_records_policy_and_effective_rate():
+    """graftpilot bench contract: with --autopilot armed (via env here)
+    every record carries the resolved policy block, the final record's
+    refresh count is honest (<= iterations, > 0), and the effective
+    per-iter rate is derived from the optimize stage seconds."""
+    recs = run_bench(800, 60, {"TSNE_AUTOPILOT": "1"})
+    final = recs[-1]
+    pol = final["policy"]
+    assert pol["autopilot"] is True
+    assert tuple(pol["stride_ladder"]) == (1, 2, 4, 8)
+    assert 0 < final["repulsion_refreshes"] <= 60
+    assert final["repulsion_refreshes"] == pol["repulsion_refreshes"]
+    for t in pol["transitions"]:
+        assert {"iter", "trigger", "stride", "grid_level",
+                "grad_norm"} <= set(t)
+    eff = final["effective_seconds_per_iter"]
+    assert eff is not None and eff > 0
+    assert eff == pytest.approx(final["stages"]["optimize"] / 60, rel=0.05)
+    # off-run twin: the static schedule is recorded, never a live trace
+    off = run_bench(800, 20)[-1]
+    assert off["policy"]["autopilot"] is False
+    assert off["policy"]["transitions"] == []
+    assert off["repulsion_refreshes"] == 20
+
+
+AUTOPILOT_RECORD = "bench_60k_fft_cpu_r12_autopilot.json"
+#: the same-host autopilot-off twin, run back-to-back with the record
+#: above — the honest denominator for the effective-rate win (r10's
+#: 0.52 s/iter was a different, host_calib-faster machine)
+AUTOPILOT_OFF_RECORD = "bench_60k_fft_cpu_r12_off.json"
+
+
+def test_committed_autopilot_record_holds_kl_guardrail():
+    """The graftpilot acceptance gate, pinned on the committed 60k
+    same-host A/B (results/optimize_ab_pilot_r12.txt).  Three claims:
+
+    * OFF IS r10: the off-run's final KL equals the r10 record's to the
+      recorded precision — the bit-identity contract holding at the
+      full bench shape on a different host;
+    * the KL GUARDRAIL holds: the autopilot's final KL stays within
+      KL_GUARDRAIL_TOL of the same-host exact-cadence run;
+    * the SPEED WIN is real and host-relative: effective s/iter beats
+      the same-host off-run by the measured margin, and the refresh
+      count shows stride rungs were actually earned.  The ROADMAP's
+      0.2 s/iter aspiration assumed the FFT dominated the iteration;
+      the A/B measures a ~0.30 s/iter single-core attraction floor
+      (stride-8 static run), so the gate pins the stride/grid levers'
+      full yield — the floor itself is the next optimization target.
+    """
+    from tsne_flink_tpu.models.autopilot import KL_GUARDRAIL_TOL
+
+    with open(os.path.join(REPO, "results", AUTOPILOT_RECORD)) as f:
+        rec = json.load(f)
+    with open(os.path.join(REPO, "results", AUTOPILOT_OFF_RECORD)) as f:
+        off = json.load(f)
+    with open(os.path.join(REPO, "results",
+                           COMMITTED_RECORDS[0])) as f:
+        r10 = json.load(f)
+    # off is r10, measured at the bench shape
+    assert off["policy"]["autopilot"] is False
+    assert off["final_kl"] == r10["final_kl"], (off["final_kl"],
+                                                r10["final_kl"])
+    # quality guardrail
+    assert rec["policy"]["autopilot"] is True
+    assert abs(rec["final_kl"] - off["final_kl"]) <= KL_GUARDRAIL_TOL, (
+        rec["final_kl"], off["final_kl"])
+    # speed win, against the same host's exact cadence
+    assert rec["repulsion_refreshes"] < 0.7 * rec["iterations"]
+    assert (rec["effective_seconds_per_iter"]
+            <= 0.85 * off["effective_seconds_per_iter"]), (
+        rec["effective_seconds_per_iter"],
+        off["effective_seconds_per_iter"])
+    assert rec["effective_seconds_per_iter"] <= 0.5  # gross-regression cap
+    assert rec["policy"]["transitions"], "no decisions on the record"

@@ -62,7 +62,7 @@ def test_all_rules_registered():
                           "cli-api-parity", "audit-contract",
                           "exception-hygiene", "timing-hygiene",
                           "resource-hygiene", "mesh-hygiene",
-                          "carry-hygiene"}
+                          "carry-hygiene", "policy-recorded"}
 
 
 # ---- every fixture violation is found, suppressions silence ---------------
@@ -81,6 +81,7 @@ FIXTURE_FOR_RULE = {
     "resource-hygiene": os.path.join("runtime", "fx_resource_hygiene.py"),
     "mesh-hygiene": os.path.join("tsne_flink_tpu", "fx_mesh_hygiene.py"),
     "carry-hygiene": os.path.join("models", "fx_carry_hygiene.py"),
+    "policy-recorded": os.path.join("ops", "fx_policy_recorded.py"),
 }
 
 

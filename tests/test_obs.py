@@ -353,6 +353,13 @@ def test_trace_report_smoke_subprocess():
     mem = payload["memory"]
     assert {r_["stage"] for r_ in mem["rows"]} == {"knn", "optimize"}
     assert len(mem["warnings"]) == 1 and "optimize" in mem["warnings"][0]
+    # graftpilot satellite: the --policy table path is smoke-covered in
+    # the same invocation — a synthetic autopilot record round-trips to
+    # raise/phase/collapse rows with the refresh count
+    pol = payload["policy"]
+    assert pol["autopilot"] is True and len(pol["rows"]) == 3
+    assert pol["rows"][0]["stride"] == "1->2"
+    assert pol["refreshes"] == 190
 
 
 def test_trace_report_memory_table_on_record(tmp_path):
@@ -373,6 +380,40 @@ def test_trace_report_memory_table_on_record(tmp_path):
     payload = json.loads(r.stdout)
     assert payload["rows"][0]["warn"] is True
     assert payload["warnings"] and "14.0x" in payload["warnings"][0]
+
+
+def test_trace_report_policy_table_on_record(tmp_path):
+    """--policy renders a bench record's graftpilot block: transitions
+    as old->new rows, the refresh count, and the static-schedule face
+    for an autopilot-off record."""
+    rec = {"repulsion_refreshes": 150, "effective_seconds_per_iter": 0.18,
+           "policy": {"autopilot": True, "stride_ladder": [1, 2, 4, 8],
+                      "final_stride": 1, "repulsion_refreshes": 150,
+                      "transitions": [
+                          {"iter": 30, "trigger": "raise",
+                           "stride": [1, 2], "grid_level": [0, 0],
+                           "grad_norm": 4.2}]}}
+    p = tmp_path / "rec.json"
+    p.write_text(json.dumps(rec))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         "--policy", str(p), "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["rows"] == [{"iter": 30, "trigger": "raise",
+                                "stride": "1->2", "grid": "0->0",
+                                "grad_norm": 4.2}]
+    assert payload["refreshes"] == 150
+    # off-record: no policy block -> explicit absence, not a crash
+    q = tmp_path / "off.json"
+    q.write_text(json.dumps({"metric": "x"}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         "--policy", str(q)],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0
+    assert "no policy block" in r.stdout
 
 
 def test_trace_report_on_real_trace(tmp_path):

@@ -330,11 +330,11 @@ contract("ops.attraction_pallas._run_loss",
 
 # ---- models/tsne.py ---------------------------------------------------------
 
-def _mk_optimize(repulsion: str):
+def _mk_optimize(repulsion: str, autopilot: bool = False):
     def make():
         from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
         cfg = TsneConfig(iterations=20, repulsion=repulsion,
-                         row_chunk=64)
+                         row_chunk=64, autopilot=autopilot)
         state = TsneState(y=_f32(N, M), update=_f32(N, M), gains=_f32(N, M))
         return (lambda st, ji, jv: optimize(st, ji, jv, cfg),
                 (state, _i32(N, S), _f32(N, S)))
@@ -347,3 +347,9 @@ contract("models.tsne.optimize[bh]", "tsne_flink_tpu/models/tsne.py",
          ("float32",) * 4, _mk_optimize("bh"))
 contract("models.tsne.optimize[fft]", "tsne_flink_tpu/models/tsne.py",
          ("float32",) * 4, _mk_optimize("fft"))
+# graftpilot: the controller carry adds exactly two float32 outputs (the
+# pilot state vector + the policy trace) after (state, losses) — pinning
+# the arity here is the audit-level face of the off = bit-identical
+# contract (armed, the program grows the pair; off, it does not exist)
+contract("models.tsne.optimize[autopilot]", "tsne_flink_tpu/models/tsne.py",
+         ("float32",) * 6, _mk_optimize("fft", autopilot=True))

@@ -241,22 +241,36 @@ def _optimize_stage(plan: PlanConfig) -> dict:
         from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
         g = DEFAULT_GRID.get(m, 1024)
         nch = 1 + m
-        big = float((2 * g) ** m)              # circulant volume (cells)
-        half = big / (2 * g) * (g + 1)         # rfft half-spectrum (cells)
         taps = 3 ** m                          # interp-order stencil
-        terms["repulsion_tile"] = (
-            big * isz                          # hoisted rho2 lattice
-            + 2.0 * big * isz                  # k1/k2 tables
-            + 2.0 * half * 2 * isz             # their rfft pair
-            + float(g ** m) * nch * isz        # spread grid
-            + taps * n * (nch + 1.0) * isz     # one-scatter spread operands
-            + big * nch * isz                  # padded grid
-            + half * nch * 2 * isz             # its rfft
-            + big * nch * isz)                 # ONE inverse volume
+
+        def fft_bytes(g_):
+            big = float((2 * g_) ** m)         # circulant volume (cells)
+            half = big / (2 * g_) * (g_ + 1)   # rfft half-spectrum (cells)
+            return (
+                big * isz                      # hoisted rho2 lattice
+                + 2.0 * big * isz              # k1/k2 tables
+                + 2.0 * half * 2 * isz         # their rfft pair
+                + float(g_ ** m) * nch * isz   # spread grid
+                + taps * n * (nch + 1.0) * isz  # one-scatter spread operands
+                + big * nch * isz              # padded grid
+                + half * nch * 2 * isz         # its rfft
+                + big * nch * isz)             # ONE inverse volume
+        terms["repulsion_tile"] = fft_bytes(g)
+        if getattr(plan, "autopilot", False):
+            # graftpilot geometry ladder: the coarse early-exaggeration
+            # rung's hoisted arrays are live alongside the fine one for
+            # the whole segment (both lax.switch branches close over
+            # their pre-hoisted FftGeom)
+            terms["repulsion_tile"] += fft_bytes(max(32, g // 2))
     # the segment's carried scalars/traces: loss + telemetry slots, and
     # the opt-in stride's (rep, Z) carry
     slots = max(1, plan.iterations // 10)
     terms["carries"] = float(slots * 6 * isz + nl * m * isz)
+    if getattr(plan, "autopilot", False):
+        # graftpilot: the carried repulsion field + Z (per-shard rows),
+        # the 3-float controller state and the [slots, 4] policy trace
+        terms["carries"] += float(nl * m * isz + isz
+                                  + 3 * isz + slots * 4 * isz)
     terms["peak"] = (resident + terms["state"] + p_arrays + attr
                      + terms["repulsion_tile"] + terms["carries"])
     return terms

@@ -74,6 +74,12 @@ class PlanConfig:
     #: per device; prepare stays host-staged (single-device) in the
     #: unified pipeline and is not scaled.
     mesh: int = 1
+    #: graftpilot: the closed-loop approximation autopilot is armed.  The
+    #: HBM model then adds the coarse FFT geometry of the phase ladder
+    #: (both rungs are pre-hoisted and live for the whole segment), the
+    #: carried (rep, Z) pair the stride controller refreshes, and the
+    #: controller state/policy-trace carry.
+    autopilot: bool = False
     name: str = "plan"
 
     def __post_init__(self):

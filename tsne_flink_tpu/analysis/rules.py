@@ -50,6 +50,14 @@ once (ADVICE/VERDICT rounds 1-5); the linter catches it forever:
   loop-invariant operand closure must say so in a rationale'd
   suppression at the loop call (graftstep: the r8 memory drift came
   from exactly this class of unexamined per-iteration allocations).
+* ``policy-recorded``  — every ``pick_*`` resolver in ``ops/``,
+  ``models/`` and ``utils/`` whose result changes the compiled program
+  stamps, in its docstring, the bench-record key the decision lands in
+  (a double-backticked key from ``RECORD_BASE_KEYS`` or the final
+  record's extra keys) — or carries a rationale'd suppression saying why
+  the record already pins the decision.  graftpilot made run-time policy
+  a first-class record citizen (the ``policy`` block); this rule keeps
+  every OTHER resolver honest about where its choice is observable.
 
 Rules are pure-AST project passes registered with :func:`core.rule`; they
 never import the code under analysis.
@@ -1285,4 +1293,94 @@ def carry_hygiene(project: Project):
                     "donated at the jit boundary); a loop-INVARIANT "
                     "operand closure is fine but must say so in a "
                     "rationale'd suppression at this call"))
+    return findings
+
+
+# ---- rule: policy-recorded -------------------------------------------------
+
+#: keys bench.py emits on the FINAL record beyond RECORD_BASE_KEYS (the
+#: per-run detail keys a resolver's decision may land in instead)
+EXTRA_RECORD_KEYS = ("attraction", "attraction_kernel", "attraction_pairs",
+                     "sym_width")
+
+#: frozen copy of bench.py's RECORD_BASE_KEYS for invocations that do not
+#: scan bench.py (fixture runs, partial-tree runs).  When bench.py IS in
+#: the scanned set its live tuple wins, so the two cannot silently drift
+#: on a whole-repo run — and the bench-record-contract rule pins the live
+#: tuple against the emission sites.
+_RECORD_KEYS_FALLBACK = (
+    "metric", "unit", "backend", "devices", "n", "iterations", "repulsion",
+    "theta", "knn_method", "knn_rounds", "knn_refine", "data", "data_seed",
+    "peak_flops", "peak_flops_basis", "assembly", "cache", "matmul_dtype",
+    "knn_tiles", "audit", "degradations", "aot_cache", "memory",
+    "host_calib", "fleet", "mesh", "kl", "repulsion_stride",
+    "effective_seconds_per_iter", "repulsion_refreshes", "policy",
+)
+
+#: record keys that describe the WORKLOAD, not a resolved decision —
+#: mentioning ``backend`` or ``n`` in passing must not count as a stamp
+_CONTEXT_KEYS = ("metric", "unit", "backend", "devices", "n", "iterations",
+                 "theta", "data", "data_seed")
+
+_BACKTICK_KEY_RE = re.compile(r"``([A-Za-z0-9_]+)``")
+
+
+def _bench_record_keys(project: Project) -> set[str]:
+    """The record keys a resolver may stamp: bench.py's live
+    ``RECORD_BASE_KEYS`` when it is in the scanned set (else the frozen
+    fallback), plus the final record's extra keys, minus the pure
+    workload-context keys."""
+    keys = None
+    mod = project.module_with_suffix("bench.py")
+    if mod is not None:
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "RECORD_BASE_KEYS"
+                            for t in node.targets)):
+                val = _literal(node.value)
+                if isinstance(val, (tuple, list)):
+                    keys = set(val)
+    if keys is None:
+        keys = set(_RECORD_KEYS_FALLBACK)
+    return (keys | set(EXTRA_RECORD_KEYS)) - set(_CONTEXT_KEYS)
+
+
+@rule("policy-recorded",
+      "pick_* resolvers in ops//models//utils/ stamp the bench-record key "
+      "their decision lands in, or carry a rationale'd suppression")
+def policy_recorded(project: Project):
+    """graftpilot's observability bar, applied to every auto policy: a
+    ``pick_*`` function resolves a choice (method, kernel, width, stride)
+    that changes the compiled program, so a committed bench record must
+    say which way it went — otherwise two records with different
+    wall-clocks are not comparable.  The check is documentary by design:
+    the docstring must name, in double backticks, at least one key from
+    ``RECORD_BASE_KEYS`` (live from bench.py when scanned) or the final
+    record's extra keys — the place a reader of the record finds the
+    resolved value.  A resolver whose output is already a pure function
+    of recorded inputs may say exactly that in a rationale'd
+    suppression instead."""
+    keys = _bench_record_keys(project)
+    findings = []
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        if not any(f"/{d}/" in norm or norm.startswith(f"{d}/")
+                   for d in ("ops", "models", "utils")):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.FunctionDef)
+                    and node.name.startswith("pick_")):
+                continue
+            doc = ast.get_docstring(node) or ""
+            stamped = set(_BACKTICK_KEY_RE.findall(doc)) & keys
+            if stamped:
+                continue
+            findings.append(mod.finding(
+                "policy-recorded", node,
+                f"policy resolver {node.name}() names no bench-record key "
+                "in its docstring: stamp the key the resolved choice "
+                "lands in (double-backticked, from RECORD_BASE_KEYS or "
+                "the final record's extra keys), or suppress with the "
+                "rationale that the record already pins the decision"))
     return findings

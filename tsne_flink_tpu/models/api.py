@@ -45,7 +45,8 @@ class TSNE:
                  max_retries: int = 2, on_oom: str = "ladder",
                  health_check: bool = False,
                  aot_cache: bool | None = None,
-                 telemetry: bool = False):
+                 telemetry: bool = False,
+                 autopilot: bool = False):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -153,6 +154,14 @@ class TSNE:
         # telemetry needs segment boundaries to be read at; off keeps the
         # unsupervised fast path bit-identical.
         self.telemetry = telemetry
+        # graftpilot (the CLI's --autopilot / $TSNE_AUTOPILOT): arm the
+        # closed-loop approximation controller — measured repulsion
+        # stride + phase-aware FFT grid, every decision recorded, final
+        # KL guarded (models/autopilot.py).  Routes through the
+        # segmented supervised path like telemetry; off keeps the fast
+        # path bit-identical.  The policy block lands in
+        # ``metrics_["policy"]`` after fit.
+        self.autopilot = autopilot
         self.embedding_ = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
@@ -165,6 +174,7 @@ class TSNE:
 
     def _config(self, n: int) -> TsneConfig:
         from tsne_flink_tpu.utils.cli import pick_repulsion
+        from tsne_flink_tpu.utils.env import env_bool as _env_bool
         from tsne_flink_tpu.utils.env import env_int as _env_int
 
         return TsneConfig(
@@ -180,7 +190,11 @@ class TSNE:
             attraction=self.attraction, bh_gate=self.bh_gate,
             # graftstep env-only knob (no estimator kwarg on purpose:
             # stride > 1 is an approximation, opted into per environment)
-            repulsion_stride=_env_int("TSNE_REPULSION_STRIDE"))
+            repulsion_stride=_env_int("TSNE_REPULSION_STRIDE"),
+            # graftpilot: the kwarg OR the env arm the controller (env
+            # lets a bench/ops environment arm it without code changes;
+            # unlike the raw stride, the autopilot is KL-guarded)
+            autopilot=bool(self.autopilot) or _env_bool("TSNE_AUTOPILOT"))
 
     def fit(self, x, y=None) -> "TSNE":
         import jax
@@ -245,6 +259,7 @@ class TSNE:
         # collect spans for this fit without flipping process-global
         # tracing state; trace_ gets exactly the fit's events
         self._last_telemetry = None
+        self._last_policy = None
         i0 = obtrace.event_count()
         with obtrace.collecting():
             out = self._fit_body(x)
@@ -256,6 +271,9 @@ class TSNE:
             self.metrics_["telemetry"] = {
                 "fields": list(TELEMETRY_FIELDS),
                 "trace": np.asarray(tel).tolist()}
+        pol = getattr(self, "_last_policy", None)
+        if pol is not None:
+            self.metrics_["policy"] = pol
         return out
 
     def _fit_body(self, x) -> "TSNE":
@@ -346,20 +364,24 @@ class TSNE:
                 affinity_assembly=self.affinity_assembly,
                 artifact_cache=self._artifact_cache())
             if (self.health_check or self.telemetry
+                    or getattr(cfg, "autopilot", False)
                     or self.mesh is not None or self.spmd
                     or faults.injector() is not None):
                 # supervised segmented path: the sentinel (and fault
-                # injection, the telemetry boundary reads, and any
-                # EXPLICIT mesh request — mesh=1 included: the trivial
-                # mesh runs the canonical program, so mesh=1 == mesh=4
-                # bit for bit) run through the unified segmented
-                # optimizer; a defaulted fit keeps the byte-identical
-                # fast path
+                # injection, the telemetry boundary reads, the graftpilot
+                # controller carry, and any EXPLICIT mesh request —
+                # mesh=1 included: the trivial mesh runs the canonical
+                # program, so mesh=1 == mesh=4 bit for bit) run through
+                # the unified segmented optimizer; a defaulted fit keeps
+                # the byte-identical fast path
                 y, losses = supervised_embed(x, cfg, supervisor=sup,
                                              telemetry=self.telemetry,
                                              mesh_devices=mesh_devices,
                                              **embed_kwargs)
                 self._last_telemetry = sup.last_telemetry
+                if getattr(cfg, "autopilot", False):
+                    from tsne_flink_tpu.models.autopilot import policy_report
+                    self._last_policy = policy_report(cfg, sup.last_pilot)
             else:
                 try:
                     # the unsupervised fast path is byte-for-byte the

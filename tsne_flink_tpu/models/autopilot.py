@@ -1,0 +1,256 @@
+"""graftpilot: closed-loop approximation autopilot for the optimize loop.
+
+After graftstep the 60k CPU bench still computed the FFT repulsion field
+exactly, at full grid resolution, on EVERY iteration — even though the
+embedding barely moves for long stretches and early exaggeration only
+needs coarse far-field forces (van der Maaten 2014 tolerates ~1%
+repulsion error by design; the reference itself only ever inspects KL at
+a report interval, TsneHelpers.scala:297).  This module turns the two
+static approximation knobs that already exist — ``TSNE_REPULSION_STRIDE``
+and the FFT ``grid`` — into one measured, recorded, KL-guarded policy:
+
+* **stride control** (closed loop): the controller rides the same
+  mesh-canonical grad-norm the telemetry carry records
+  (``models/tsne._telemetry_row``) and, at every KL report boundary,
+  compares it with the grad-norm one report interval earlier.  A smooth
+  trend (relative change < :data:`SMOOTH_REL`) climbs one rung of
+  :data:`STRIDE_LADDER`; a rough trend (> :data:`ROUGH_REL`) or the
+  convergence tail (:func:`tail_start`) collapses to stride 1; the
+  divergence sentinel arming (``runtime/health.py`` rollback) resets the
+  controller host-side (:func:`pilot_collapse`) before the retry.
+* **phase-aware FFT grid** (open loop, iteration-keyed): the
+  early-exaggeration phase (``i < cfg.exaggeration_end``) runs a coarse
+  grid, the late phase the full one — both geometries are hoisted ONCE
+  (:func:`fft_ladder`) and selected by ``lax.switch`` on the absolute
+  iteration, so the program stays a single compiled segment.  A refresh
+  is forced at the phase boundary so no coarse field leaks into the
+  fine phase.
+* **every decision is recorded**: a ``[n_loss_slots,
+  len(PILOT_TRACE_FIELDS)]`` policy trace rides the loop carry exactly
+  like the loss/telemetry traces (slot t <=> absolute iteration
+  10·(t+1)) and lands on bench records as the ``policy`` block
+  (:func:`policy_report`); ``scripts/trace_report.py --policy`` renders
+  the transitions.
+
+Determinism contract (the acceptance pin): every decision is a pure
+function of the absolute iteration and carried mesh-canonical values —
+no wall-clock, no host state — so a checkpoint resume mid-schedule
+(``pilot_carry`` through ``utils/checkpoint.py``) reproduces the exact
+decision sequence, and mesh widths sharing the padding quantum make
+bit-identical decisions (pinned by tests/test_autopilot.py).
+
+Guardrail: speed must never silently buy quality loss.
+:data:`KL_GUARDRAIL_TOL` is the ONE pinned tolerance between an
+autopilot run's final KL and the exact (autopilot-off) run's — the bench
+A/B gate (tests/test_bench_contract.py, committed records) and
+``scripts/validate_quality.py --autopilot`` both import it from here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from tsne_flink_tpu.models.tsne import LOSS_EVERY, TsneConfig
+
+#: stride rungs the controller climbs (index = stride level).  The top
+#: rung refreshes the repulsion field every 8th iteration — beyond that
+#: the carried far field is stale enough to show up in KL at the 60k
+#: bench shape (measured; results/bench_60k_fft_cpu_r12_autopilot.json).
+STRIDE_LADDER = (1, 2, 4, 8)
+
+#: relative grad-norm change per report interval below which the trend
+#: counts as smooth (climb one stride rung) ...
+SMOOTH_REL = 0.15
+#: ... and above which it counts as rough (collapse to stride 1).
+#: Between the two the controller holds its rung (hysteresis band).
+ROUGH_REL = 0.40
+
+#: pinned |final KL(autopilot) - final KL(exact)| tolerance — the
+#: guardrail every speed win is gated on (bench A/B + quality script).
+KL_GUARDRAIL_TOL = 0.05
+
+#: columns of the on-device policy trace (one row per KL report slot).
+PILOT_TRACE_FIELDS = ("stride", "grid_level", "grad_norm", "trigger")
+
+#: trigger codes recorded in the policy trace's ``trigger`` column.
+PILOT_TRIGGERS = ("hold", "raise", "collapse-rough", "collapse-tail",
+                  "warmup")
+
+#: scalar controller state riding the loop carry, packed as one float
+#: vector (state dtype; the integer entries are exact small counts).
+PILOT_STATE_FIELDS = ("stride_level", "grad_norm_prev", "refreshes")
+
+
+def tail_start(cfg: TsneConfig) -> int:
+    """First absolute iteration of the convergence tail, where the
+    controller pins stride 1 (and the grid ladder is already fine): the
+    final 20% of the schedule, at least two report intervals.  Final KL
+    is formed almost entirely in this window — the 10k guardrail run
+    measured a 10% tail leaving the fft+autopilot gap at +0.054 vs the
+    0.05 tolerance, while the wider tail costs only ~10% of the banked
+    speedup at the 60k bench shape."""
+    return max(0, cfg.iterations - max(2 * LOSS_EVERY,
+                                       cfg.iterations // 5))
+
+
+def grid_ladder(cfg: TsneConfig, m: int) -> tuple[int, ...]:
+    """(coarse, fine) FFT grid sizes for the phase ladder.  Fine is the
+    configured grid; coarse halves it during early exaggeration, where
+    the embedding spans ~a few units and h stays far below the kernel's
+    unit scale (floor 32 keeps tiny test grids meaningful).  Non-FFT
+    runs get a single-entry ladder (stride control only)."""
+    if cfg.repulsion != "fft":
+        return ()
+    from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
+    g = cfg.fft_grid if cfg.fft_grid is not None else DEFAULT_GRID.get(m)
+    return (max(32, int(g) // 2), int(g))
+
+
+def grid_phase(i, cfg: TsneConfig):
+    """Ladder index for absolute iteration ``i`` (traced): 0 = coarse
+    while early exaggeration runs, 1 = fine after — a pure function of
+    the iteration, so it is trivially resume-deterministic."""
+    return jnp.where(i < cfg.exaggeration_end, 0, 1).astype(jnp.int32)
+
+
+def pilot_init(cfg: TsneConfig, dtype) -> jnp.ndarray:
+    """Fresh controller state: stride level 0, no grad-norm history
+    (grad_norm_prev = 0 encodes 'warmup': no trend to act on yet), zero
+    refreshes."""
+    return jnp.zeros((len(PILOT_STATE_FIELDS),), dtype)
+
+
+def trace_init(cfg: TsneConfig, dtype) -> jnp.ndarray:
+    """Empty policy trace, one row per KL report slot."""
+    return jnp.zeros((max(cfg.n_loss_slots, 1), len(PILOT_TRACE_FIELDS)),
+                     dtype)
+
+
+def pilot_collapse(pvec) -> jnp.ndarray:
+    """Host-side sentinel reset (the 'divergence sentinel arms' input of
+    the controller): stride level back to 0 and the trend history
+    cleared, so the retried segment re-earns every rung; the refresh
+    count survives (it meters work actually done)."""
+    import numpy as np
+    out = np.asarray(pvec).copy()
+    out[0] = 0.0
+    out[1] = 0.0
+    return jnp.asarray(out)
+
+
+def stride_of(pvec):
+    """Current stride (traced int32) from the carried controller state."""
+    ladder = jnp.asarray(STRIDE_LADDER, jnp.int32)
+    return ladder[pvec[0].astype(jnp.int32)]
+
+
+def pilot_update(i, gn, pvec, trace_arr, refreshed, slot, record,
+                 cfg: TsneConfig):
+    """One controller step, at the END of iteration ``i`` (the decision
+    applies from ``i + 1``).  Pure jnp on carried values + the absolute
+    iteration: the decision sequence is identical for any segmentation
+    of the schedule and any mesh width (``gn`` is mesh-canonical).
+
+    Every iteration: count the refresh.  At report boundaries
+    (``record``): compare ``gn`` with the carried previous report's
+    grad-norm, move the stride level, stamp the policy trace slot with
+    (stride after the decision, grid level of the NEXT iteration,
+    grad-norm at decision, trigger code).
+
+    The slot that CROSSES the exaggeration boundary (``gn`` measured
+    under normal P, the carried ``gn_prev`` under exaggerated P) is
+    treated as warmup: the ~4x P rescale makes the trend meaningless,
+    and reading it as rough would collapse a rung the embedding's
+    smoothness never forfeited (measured: the r12 bench re-earned
+    stride 8 over 5 slots after exactly that artifact).  The level
+    holds and the history re-primes with the post-boundary ``gn``."""
+    dt = trace_arr.dtype
+    level = pvec[0].astype(jnp.int32)
+    gn_prev = pvec[1]
+    refreshes = pvec[2] + refreshed.astype(dt)
+
+    warm = gn_prev <= jnp.zeros((), dt)
+    crossed = grid_phase(i, cfg) != grid_phase(i - LOSS_EVERY, cfg)
+    warm = warm | crossed
+    rel = jnp.abs(gn - gn_prev) / jnp.maximum(gn_prev,
+                                              jnp.asarray(1e-12, dt))
+    in_tail = (i + 1) >= tail_start(cfg)
+    max_level = len(STRIDE_LADDER) - 1
+    climb = (~warm) & (rel < SMOOTH_REL) & (~in_tail)
+    rough = (~warm) & (rel > ROUGH_REL)
+    new_level = jnp.where(
+        in_tail, 0,
+        jnp.where(rough, 0,
+                  jnp.where(climb, jnp.minimum(level + 1, max_level),
+                            level)))
+    # trigger codes, precedence matching the level decision above
+    trigger = jnp.where(
+        in_tail, 3,
+        jnp.where(rough, 2,
+                  jnp.where(climb, 1, jnp.where(warm, 4, 0))))
+    # off-report iterations keep the controller frozen
+    new_level = jnp.where(record, new_level, level)
+    new_gn_prev = jnp.where(record, gn, gn_prev)
+    ladder = jnp.asarray(STRIDE_LADDER, dt)
+    row = jnp.stack([ladder[new_level],
+                     grid_phase(i + 1, cfg).astype(dt),
+                     gn, trigger.astype(dt)])
+    trace_arr = trace_arr.at[slot].set(
+        jnp.where(record, row, trace_arr[slot]))
+    pvec = jnp.stack([new_level.astype(dt), new_gn_prev, refreshes])
+    return pvec, trace_arr
+
+
+# ---------------------------------------------------------------------------
+# host-side reporting (bench record `policy` block, trace_report --policy)
+
+def policy_report(cfg: TsneConfig, pilot, iterations_run: int | None = None
+                  ) -> dict:
+    """JSON-safe ``policy`` block for bench records from the run's final
+    pilot carry ``(pvec, trace)``: ladder identities, the decision
+    transitions (iter, trigger, old -> new stride/grid, grad-norm at
+    decision), and the refresh count.  ``pilot=None`` (autopilot off)
+    reports the static policy so the record key is never absent."""
+    import numpy as np
+    iters = int(iterations_run if iterations_run is not None
+                else cfg.iterations)
+    stride = max(1, int(getattr(cfg, "repulsion_stride", 1)))
+    base = {
+        "autopilot": bool(getattr(cfg, "autopilot", False)),
+        "stride_ladder": list(STRIDE_LADDER),
+        "grid_ladder": list(grid_ladder(cfg, cfg.n_components)),
+        "kl_guardrail_tol": KL_GUARDRAIL_TOL,
+        "smooth_rel": SMOOTH_REL, "rough_rel": ROUGH_REL,
+        "tail_start": tail_start(cfg),
+        "decide_every": LOSS_EVERY,
+    }
+    if pilot is None:
+        # static schedule: refreshes = ceil(iters / stride) exactly (the
+        # loop refreshes at i % stride == 0 plus the segment starts,
+        # which land on multiples under the bench's aligned segments)
+        base.update({"transitions": [],
+                     "repulsion_refreshes": (iters + stride - 1) // stride
+                     if iters else 0,
+                     "final_stride": stride})
+        return base
+    pvec, trace = (np.asarray(pilot[0], np.float64),
+                   np.asarray(pilot[1], np.float64))
+    transitions = []
+    prev_stride, prev_grid = 1.0, 0.0
+    n_slots = min(trace.shape[0], max(iters // LOSS_EVERY, 0))
+    for t in range(n_slots):
+        stride_t, grid_t, gn_t, trig_t = trace[t]
+        if stride_t != prev_stride or grid_t != prev_grid:
+            transitions.append({
+                "iter": LOSS_EVERY * (t + 1),
+                "trigger": PILOT_TRIGGERS[int(trig_t)]
+                if stride_t != prev_stride else "phase",
+                "stride": [int(prev_stride), int(stride_t)],
+                "grid_level": [int(prev_grid), int(grid_t)],
+                "grad_norm": float(gn_t)})
+        prev_stride, prev_grid = stride_t, grid_t
+    base.update({"transitions": transitions,
+                 "repulsion_refreshes": int(pvec[2]),
+                 "final_stride": int(prev_stride)})
+    return base

@@ -70,7 +70,9 @@ def pick_csr_width(n_edges: int, n_rows: int, s: int) -> int:
     rounded up to a 64-lane multiple (64 <= W <= S).  Decided on GLOBAL
     quantities only, so every mesh width agrees (the layout-gate rule of
     ``ShardedOptimizer.attraction_plan``).  ``TSNE_ATTRACTION_WIDTH``
-    overrides for A/B evidence runs."""
+    overrides for A/B evidence runs.  The resolved width is pinned by the
+    final record's ``attraction_pairs`` count (head slots = N x W plus
+    the tail)."""
     from tsne_flink_tpu.utils.env import env_int
     override = env_int("TSNE_ATTRACTION_WIDTH")
     if override:
@@ -388,7 +390,9 @@ def pick_attraction_kernel(backend: str | None = None) -> str:
     everywhere else.  ``TSNE_ATTRACTION_KERNEL`` overrides: ``pallas`` |
     ``interpret`` (interpret-mode Pallas — the CPU parity configuration) |
     ``xla`` | ``auto``.  Foreign-backend calls (graftcheck planning) skip
-    the probe; the runtime probe still guards the actual launch."""
+    the probe; the runtime probe still guards the actual launch.  What
+    actually ran lands on the final bench record as
+    ``attraction_kernel``."""
     from tsne_flink_tpu.utils.env import env_str
     mode = env_str("TSNE_ATTRACTION_KERNEL")
     if mode == "interpret":

@@ -363,7 +363,8 @@ def pick_knn_kernel(backend: str | None = None) -> str:
     configuration) | ``xla`` | ``auto``.  When called for a FOREIGN backend
     (the graftcheck plan auditors run TPU plans on CPU hosts) the probe is
     skipped — planning assumes the kernel lowers; the runtime probe still
-    guards the actual launch."""
+    guards the actual launch.  The resolved kernel rides the tile plan
+    onto every bench record (the ``knn_tiles`` block's kernel field)."""
     from tsne_flink_tpu.utils.env import env_str
     mode = env_str("TSNE_KNN_KERNEL")
     if mode == "interpret":

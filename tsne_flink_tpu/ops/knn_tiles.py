@@ -210,6 +210,9 @@ def pick_knn_tiles(n: int, d: int, k: int, backend: str | None = None,
     (:data:`DEFAULT_BUDGET_BYTES`).  Monotonic by construction: a larger
     budget never shrinks any tile, and every tile's estimated working
     set respects ``hbm_bytes * TILE_BUDGET_FRACTION``.
+
+    The resolved plan (tile shapes, source, kernel) lands on every bench
+    record as the ``knn_tiles`` block (:meth:`KnnTilePlan.as_record`).
     """
     if backend is None:
         import jax

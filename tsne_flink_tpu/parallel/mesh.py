@@ -186,11 +186,18 @@ class ShardedOptimizer:
         #: in-loop telemetry trace of the last ``__call__(telemetry=True)``
         #: run: host numpy [n_loss_slots, len(TELEMETRY_FIELDS)] (obs)
         self.telemetry_ = None
+        #: graftpilot carry of the last ``cfg.autopilot`` run: a host
+        #: numpy pair (pilot state vector, policy trace) — refreshed at
+        #: every segment boundary BEFORE the checkpoint callback fires,
+        #: so checkpoint writers can snapshot it for a
+        #: decision-reproducing resume (utils/checkpoint.save(pilot=...))
+        self.pilot_ = None
 
     def _segment_fn(self, num_iters: int, with_edges: bool = False,
                     trace_edge_pad: int | None = None,
                     edges_extra: bool = False, with_health: bool = False,
-                    with_telemetry: bool = False, with_csr: bool = False):
+                    with_telemetry: bool = False, with_csr: bool = False,
+                    with_pilot: bool = False):
         """``with_edges``: host-prebuilt edge arrays ride as extra inputs.
         ``with_csr``: the capped-width CSR attraction layout (graftstep)
         rides as five point-sharded arrays (head [N, W] idx/val + the
@@ -205,9 +212,13 @@ class ShardedOptimizer:
         sentinel's replicated finiteness flag (models/tsne.optimize).
         ``with_telemetry``: the segment also carries and returns the
         replicated in-loop telemetry trace (obs; same slot keying as the
-        losses)."""
+        losses).  ``with_pilot``: graftpilot (``cfg.autopilot``) — the
+        replicated controller state + policy trace pair rides as one
+        extra input/output, threaded across segments like the telemetry
+        carry (every pilot value is mesh-canonical, so the pair is
+        identical on all shards)."""
         key = (num_iters, with_edges, trace_edge_pad, edges_extra,
-               with_health, with_telemetry, with_csr)
+               with_health, with_telemetry, with_csr, with_pilot)
         if key in self._fns:
             return self._fns[key]
         cfg_ = self.cfg
@@ -219,6 +230,7 @@ class ShardedOptimizer:
             edges = rest.pop(0) if with_edges else None
             csr = rest.pop(0) if with_csr else None
             tel_carry = rest.pop(0) if with_telemetry else None
+            pilot_carry = rest.pop(0) if with_pilot else None
             row_offset = lax.axis_index(AXIS) * n_local
             if edges is None and trace_edge_pad is not None:
                 from tsne_flink_tpu.ops.affinities import assemble_edges
@@ -230,7 +242,8 @@ class ShardedOptimizer:
                             edges_extra=edges_extra, csr=csr,
                             with_health=with_health,
                             with_telemetry=with_telemetry,
-                            telemetry_carry=tel_carry)
+                            telemetry_carry=tel_carry,
+                            pilot_carry=pilot_carry)
 
         in_specs = [state_pspec(), pspec(), pspec(), pspec(), rspec(),
                     rspec()]
@@ -240,11 +253,16 @@ class ShardedOptimizer:
             in_specs.append((pspec(),) * 5)
         if with_telemetry:
             in_specs.append(rspec())  # telemetry carry is replicated
-        # loss trace (and the telemetry rows / sentinel flag) are
-        # mesh-canonically reduced / pmin-pmax replicated global values
+        if with_pilot:
+            in_specs.append((rspec(), rspec()))  # pilot state + trace
+        # loss trace (and the telemetry rows / sentinel flag / pilot
+        # carry) are mesh-canonically reduced / pmin-pmax replicated
+        # global values
         outs = [state_pspec(), rspec()]
         if with_telemetry:
             outs.append(rspec())
+        if with_pilot:
+            outs.append((rspec(), rspec()))
         if with_health:
             outs.append(rspec())
         # donated carry buffers (graftmesh perf): the state and the loss /
@@ -259,6 +277,9 @@ class ShardedOptimizer:
             donate = (0, 5)
             if with_telemetry:
                 donate = donate + (6 + int(with_edges) + int(with_csr),)
+            if with_pilot:
+                donate = donate + (6 + int(with_edges) + int(with_csr)
+                                   + int(with_telemetry),)
         from tsne_flink_tpu.utils.compat import shard_map
         fn = jax.jit(
             shard_map(
@@ -442,19 +463,28 @@ class ShardedOptimizer:
         state, jidx, jval, valid = self._pad_inputs(state, jidx, jval)
         csr = self._build_csr(jidx, jval)
         edges = None if csr is not None else self._build_edges(jidx, jval)
+        with_pilot = bool(getattr(self.cfg, "autopilot", False))
         fn = self._segment_fn(self.cfg.iterations,
                               with_edges=edges is not None,
-                              with_csr=csr is not None)
+                              with_csr=csr is not None,
+                              with_pilot=with_pilot)
         args = [state, jidx, jval, valid, 0, self._loss0(state.y.dtype)]
         if edges is not None:
             args.append(edges)
         if csr is not None:
             args.append(csr)
+        if with_pilot:
+            args.append(self._pilot0(state.y.dtype))
         return fn.lower(*args)
+
+    def _pilot0(self, dtype):
+        from tsne_flink_tpu.models import autopilot as pilot
+        return (pilot.pilot_init(self.cfg, dtype),
+                pilot.trace_init(self.cfg, dtype))
 
     def _run_segment(self, fn, state, jidx, jval, valid, start, losses,
                      edges=None, csr=None, tel=None,
-                     telemetry: bool = False):
+                     telemetry: bool = False, pilot=None):
         args = [state, jidx, jval, valid, start, losses]
         if edges is not None:
             args.append(edges)
@@ -462,6 +492,8 @@ class ShardedOptimizer:
             args.append(csr)
         if telemetry:
             args.append(tel)
+        if pilot is not None:
+            args.append(pilot)
         return fn(*args)
 
     def __call__(self, state: TsneState, jidx, jval, *, start_iter: int = 0,
@@ -470,7 +502,7 @@ class ShardedOptimizer:
                  edge_pad: int | None = None, extra_edges=None,
                  health_check: bool = False, health_retries: int = 3,
                  events: list | None = None, telemetry: bool = False,
-                 telemetry_carry=None):
+                 telemetry_carry=None, pilot_carry=None):
         """Run iterations [start_iter, cfg.iterations); if checkpointing,
         ``checkpoint_cb(state, next_iter, losses)`` fires every
         ``checkpoint_every`` iterations with the UNPADDED state.
@@ -566,6 +598,25 @@ class ShardedOptimizer:
                    if telemetry_carry is not None
                    else jnp.zeros((max(self.cfg.n_loss_slots, 1),
                                    len(TELEMETRY_FIELDS)), state.y.dtype))
+        # graftpilot is armed by the CONFIG (cfg.autopilot), not a call
+        # kwarg — the controller carry then threads across segments like
+        # the telemetry trace; ``pilot_carry`` resumes a mid-schedule
+        # (pvec, trace) pair from a checkpoint so the resumed run makes
+        # the identical decision sequence
+        with_pilot = bool(getattr(self.cfg, "autopilot", False))
+        pilot = None
+        if with_pilot:
+            if pilot_carry is not None:
+                pvec, ptr = (jnp.asarray(p, state.y.dtype)
+                             for p in pilot_carry)
+                want = max(self.cfg.n_loss_slots, 1)
+                if ptr.shape[0] < want:  # resumed into a longer schedule
+                    ptr = jnp.pad(ptr, ((0, want - ptr.shape[0]), (0, 0)))
+                elif ptr.shape[0] > want:
+                    ptr = ptr[:want]
+                pilot = (pvec, ptr)
+            else:
+                pilot = self._pilot0(state.y.dtype)
         from tsne_flink_tpu.runtime import faults
         inj = faults.injector()
         total = self.cfg.iterations
@@ -580,14 +631,15 @@ class ShardedOptimizer:
                 break
             seg_key = (step, edges is not None, trace_pad,
                        extra_edges is not None, health_check, telemetry,
-                       csr is not None)
+                       csr is not None, with_pilot)
             fn = self._maybe_aot(
                 self._segment_fn(step, with_edges=edges is not None,
                                  trace_edge_pad=trace_pad,
                                  edges_extra=extra_edges is not None,
                                  with_health=health_check,
                                  with_telemetry=telemetry,
-                                 with_csr=csr is not None), seg_key)
+                                 with_csr=csr is not None,
+                                 with_pilot=with_pilot), seg_key)
             seg_index += 1
             run_state = state
             if inj is not None:
@@ -603,10 +655,12 @@ class ShardedOptimizer:
                               num_iters=int(step)) as sp:
                 out = self._run_segment(fn, run_state, jidx, jval, valid,
                                         it, losses, edges, csr, tel,
-                                        telemetry=telemetry)
+                                        telemetry=telemetry, pilot=pilot)
                 out = out if isinstance(out, tuple) else (out,)
                 new_state, new_losses = out[0], out[1]
                 new_tel = out[2] if telemetry else None
+                new_pilot = (out[2 + int(telemetry)] if with_pilot
+                             else None)
                 if health_check:
                     ok = out[-1]
                     if not bool(ok):  # ONE host scalar read, at boundary
@@ -620,6 +674,15 @@ class ShardedOptimizer:
                         self._fns.clear()  # cfg changed: fns retrace
                         self._aot_fns.clear()  # (and AOT wrappers rekey)
                         state = rhealth.fresh_momentum(state)
+                        if with_pilot:
+                            # the sentinel arming is a controller input
+                            # (graftpilot): collapse to stride 1 and
+                            # clear the trend history for the retry —
+                            # a deterministic, recorded reset
+                            from tsne_flink_tpu.models import \
+                                autopilot as _ap
+                            pilot = (_ap.pilot_collapse(pilot[0]),
+                                     pilot[1])
                         ev = rhealth.rollback_event(
                             segment_start=it, step=step, eta_before=eta,
                             eta_after=self.cfg.learning_rate,
@@ -641,6 +704,13 @@ class ShardedOptimizer:
                 if telemetry:
                     tel = new_tel
                 it += step
+                if with_pilot:
+                    pilot = new_pilot
+                    # refreshed BEFORE the checkpoint callback fires, so
+                    # checkpoint writers can snapshot the controller for
+                    # a decision-reproducing resume
+                    self.pilot_ = (np.asarray(pilot[0]),
+                                   np.asarray(pilot[1]))
                 if checkpoint_cb is not None and it < total:
                     checkpoint_cb(self._unpad(state) if unpad else state,
                                   it, losses)
@@ -651,6 +721,8 @@ class ShardedOptimizer:
         if telemetry:
             # the one host read of the telemetry trace, after the loop
             self.telemetry_ = np.asarray(tel)
+        if with_pilot and pilot is not None:
+            self.pilot_ = (np.asarray(pilot[0]), np.asarray(pilot[1]))
         return (self._unpad(state) if unpad else state), losses
 
 

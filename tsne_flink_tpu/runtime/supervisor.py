@@ -97,6 +97,8 @@ class Supervisor:
         self._last = None
         #: host telemetry trace of the last run_optimize(telemetry=True)
         self.last_telemetry = None
+        #: graftpilot (pvec, trace) pair of the last autopilot run
+        self.last_pilot = None
 
     # ---- shared ladder plumbing -------------------------------------------
 
@@ -194,7 +196,8 @@ class Supervisor:
     def run_optimize(self, make_runner, cfg, state, jidx, jval, *,
                      start_iter: int = 0, loss_carry=None,
                      checkpoint_every: int = 0, checkpoint_cb=None,
-                     extra_edges=None, telemetry: bool = False):
+                     extra_edges=None, telemetry: bool = False,
+                     pilot_carry=None):
         """Segmented optimize with OOM-ladder relaunch and the sentinel.
 
         ``make_runner(cfg)`` builds a ``ShardedOptimizer``-compatible
@@ -203,22 +206,35 @@ class Supervisor:
         repulsion demotion relaunches from the last segment boundary —
         not from iteration 0.  ``telemetry`` arms the in-loop telemetry
         trace (obs); the runner's host-side trace lands in
-        ``self.last_telemetry`` after the run."""
+        ``self.last_telemetry`` after the run.  ``pilot_carry`` resumes
+        a graftpilot controller pair from a checkpoint; the live pair is
+        re-captured at every boundary (``self.last_pilot``) so ladder
+        relaunches — and checkpoint writers — carry it forward."""
         import numpy as np
 
         self._last = {"state": state, "it": start_iter,
-                      "losses": loss_carry}
+                      "losses": loss_carry, "pilot": pilot_carry}
         self.last_telemetry = None
+        self.last_pilot = pilot_carry
+        live = {"runner": None}
 
         def cb(st, next_iter, losses):
+            # the runner refreshes its pilot_ attribute BEFORE this
+            # callback fires (parallel/mesh.py), so a ladder relaunch
+            # resumes the controller mid-schedule instead of resetting it
             self._last = {"state": st, "it": next_iter,
-                          "losses": np.asarray(losses)}
+                          "losses": np.asarray(losses),
+                          "pilot": getattr(live["runner"], "pilot_", None)}
+            self.last_pilot = self._last["pilot"]
             if checkpoint_cb is not None:
                 checkpoint_cb(st, next_iter, losses)
 
         for attempt in range(self.max_retries + 1):
             runner = make_runner(self.optimize_cfg(cfg))
+            live["runner"] = runner
             try:
+                kw = ({"pilot_carry": self._last["pilot"]}
+                      if self._last.get("pilot") is not None else {})
                 out = runner(self._last["state"], jidx, jval,
                              start_iter=self._last["it"],
                              loss_carry=self._last["losses"],
@@ -226,8 +242,9 @@ class Supervisor:
                              checkpoint_cb=cb, extra_edges=extra_edges,
                              health_check=self.health_check,
                              health_retries=self.health_retries,
-                             events=self.events, telemetry=telemetry)
+                             events=self.events, telemetry=telemetry, **kw)
                 self.last_telemetry = getattr(runner, "telemetry_", None)
+                self.last_pilot = getattr(runner, "pilot_", None)
                 return out
             # graftlint: disable=exception-hygiene -- not a swallow:
             # _handle_oom re-raises everything that is not a
@@ -258,7 +275,8 @@ def run_plan_from_fit(n: int, d: int, k: int, cfg, assembly: str,
         knn_method=knn_method, knn_rounds=knn_rounds, knn_refine=knn_refine,
         repulsion=cfg.repulsion, theta=cfg.theta, assembly=assembly,
         attraction=cfg.attraction, sym_width=sym_width,
-        row_chunk=cfg.row_chunk, mesh=int(mesh), name=name)
+        row_chunk=cfg.row_chunk, mesh=int(mesh),
+        autopilot=bool(getattr(cfg, "autopilot", False)), name=name)
 
 
 def supervised_embed(x, cfg, *, supervisor: Supervisor,

@@ -96,7 +96,7 @@ def _content_hash(arrays: dict) -> str:
 
 def save(path: str, state: TsneState, next_iter: int,
          losses: np.ndarray, prepare: dict | None = None,
-         keep: int = 2) -> None:
+         keep: int = 2, pilot=None) -> None:
     """Atomic, verified, rotating write.
 
     tmp + rename so an interrupt never corrupts the file; a sha256
@@ -106,13 +106,20 @@ def save(path: str, state: TsneState, next_iter: int,
     predecessor for :func:`load_fallback`.  ``prepare`` (optional) is the
     v2 payload dict — any subset of :data:`PREPARE_KEYS`; pass the
     artifact arrays too for a fat checkpoint whose resume needs no
-    artifact cache at all."""
+    artifact cache at all.  ``pilot`` (optional, graftpilot) is the
+    ``(state vector, policy trace)`` controller pair at this boundary
+    (``ShardedOptimizer.pilot_``) — resuming with it
+    (:func:`load_pilot` -> ``pilot_carry``) reproduces the exact
+    decision sequence of the uninterrupted run."""
     extras = {}
     for k, v in (prepare or {}).items():
         if k not in PREPARE_KEYS:
             raise ValueError(f"unknown prepare payload key '{k}' "
                              f"({' | '.join(PREPARE_KEYS)})")
         extras["prep_" + k] = np.asarray(v)
+    if pilot is not None:
+        extras["pilot_state"] = np.asarray(pilot[0])
+        extras["pilot_trace"] = np.asarray(pilot[1])
     payload = {"magic": np.asarray(MAGIC), "y": np.asarray(state.y),
                "update": np.asarray(state.update),
                "gains": np.asarray(state.gains),
@@ -194,6 +201,17 @@ def load_fallback(path: str):
         print(f"WARNING: {e}; falling back to the previous checkpoint "
               f"{prev}", file=sys.stderr)
         return (*load(prev), prev)
+
+
+def load_pilot(path: str):
+    """The graftpilot controller pair ``(state vector, policy trace)``
+    saved at this boundary, or None when the file has none (autopilot
+    was off, or a pre-graftpilot file).  Feed it back as the optimizer's
+    ``pilot_carry`` so the resumed run replays the same decisions."""
+    with _open_verified(path) as z:
+        if "pilot_state" not in z.files:
+            return None
+        return z["pilot_state"], z["pilot_trace"]
 
 
 def load_prepare(path: str) -> dict | None:

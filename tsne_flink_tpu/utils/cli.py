@@ -260,6 +260,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(zero in-segment host syncs, read once per "
                         "segment boundary; off = bit-identical program). "
                         "The last values land in --metricsOut gauges")
+    p.add_argument("--autopilot", action="store_true",
+                   help="graftpilot closed-loop approximation autopilot "
+                        "(models/autopilot.py): auto-tune the repulsion "
+                        "stride off the grad-norm trend and run a "
+                        "phase-aware FFT grid ladder, every decision "
+                        "recorded as a policy trace, final KL guarded "
+                        "within the pinned tolerance of the exact run. "
+                        "Env default: $TSNE_AUTOPILOT; off = "
+                        "bit-identical program")
     p.add_argument("--profile", default=None,
                    help="jax.profiler trace directory")
     # multi-host bring-up (jax.distributed over DCN — the analog of the
@@ -274,6 +283,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 # policy lives next to the mechanism (ops/knn.py); re-exported here because
 # the CLI is where users meet it and tests/scripts import it from both
+# graftlint: disable=policy-recorded -- re-export shim: the policy and its
+# ``knn_rounds`` record stamp live at ops/knn.pick_knn_rounds
 def pick_knn_rounds(n: int) -> int:
     from tsne_flink_tpu.ops.knn import pick_knn_rounds as _p
     return _p(n)
@@ -328,7 +339,11 @@ def pick_repulsion(mode: str, theta: float, n: int, n_components: int = 2,
     results/bench_60k_bh_tpu.json) while the fused exact kernel handles
     any m at MXU rate.  BH remains the 3-D PARITY/ORACLE backend (the
     reference's only approximate path, ops/repulsion_bh.py docstring) and
-    still owns explicit-theta requests and beyond-HBM N."""
+    still owns explicit-theta requests and beyond-HBM N.
+
+    The resolved mode lands on every bench record as ``repulsion``; under
+    the autopilot the run-time schedule around it lands in the record's
+    ``policy`` block (models/autopilot.py)."""
     if mode != "auto":
         return mode
     if backend is None:
@@ -365,6 +380,7 @@ def _run_plan(args, cfg, n: int, assembly: str, neighbors: int):
         assembly=assembly, attraction=cfg.attraction,
         sym_width=args.symWidth, row_chunk=cfg.row_chunk,
         mesh=int(mesh_n) if mesh_n else jax.device_count(),
+        autopilot=bool(getattr(cfg, "autopilot", False)),
         name="cli-launch")
 
 
@@ -442,17 +458,20 @@ def _check_resumed_audit(args, cfg, n, assembly, neighbors, prep_payload):
 
 
 def _load_resume(args, dtype):
-    """(start_iter, loss_carry, TsneState|None, prepare_payload|None) from
-    --resume, shared by the host-staged and --spmd branches.  The payload is
-    a v2 checkpoint's embedded prepare artifacts (utils/checkpoint.py);
-    v1 files simply return None there and the caller recomputes."""
+    """(start_iter, loss_carry, TsneState|None, prepare_payload|None,
+    pilot_carry|None) from --resume, shared by the host-staged and --spmd
+    branches.  The payload is a v2 checkpoint's embedded prepare artifacts
+    (utils/checkpoint.py); v1 files simply return None there and the
+    caller recomputes.  ``pilot_carry`` is the graftpilot controller pair
+    saved at the boundary — resuming with it reproduces the exact
+    decision sequence of the uninterrupted run."""
     import jax.numpy as jnp
 
     from tsne_flink_tpu.models.tsne import TsneState
     from tsne_flink_tpu.utils import checkpoint as ckpt
 
     if not args.resume:
-        return 0, None, None, None
+        return 0, None, None, None, None
     # verified load with keep-last-2 degradation: a corrupt/truncated
     # newest file falls back to the rotated predecessor with a warning
     # (utils/checkpoint.load_fallback) instead of a numpy traceback
@@ -461,8 +480,9 @@ def _load_resume(args, dtype):
                       update=jnp.asarray(st_np.update, dtype),
                       gains=jnp.asarray(st_np.gains, dtype))
     payload = ckpt.load_prepare(used)
+    pilot = ckpt.load_pilot(used)
     print(f"resumed from {used} at iteration {start_iter}")
-    return start_iter, loss_carry, state, payload
+    return start_iter, loss_carry, state, payload, pilot
 
 
 def _payload_with_events(prepare_payload, supervisor, prior):
@@ -503,9 +523,13 @@ def _make_checkpoint_cb(args, prepare_payload=None, supervisor=None,
     from tsne_flink_tpu.utils import checkpoint as ckpt
 
     def cb(st, next_iter, losses):
+        # the supervisor re-captures the runner's controller pair at
+        # every boundary BEFORE this fires, so the checkpoint carries the
+        # graftpilot state for a decision-reproducing resume
         ckpt.save(args.checkpoint, st, next_iter, np.asarray(losses),
                   prepare=_payload_with_events(prepare_payload, supervisor,
-                                               prior_events))
+                                               prior_events),
+                  pilot=getattr(supervisor, "last_pilot", None))
     return cb
 
 
@@ -519,7 +543,8 @@ def _save_final_checkpoint(args, state, iterations, losses,
     from tsne_flink_tpu.utils import checkpoint as ckpt
     ckpt.save(args.checkpoint, state, iterations, np.asarray(losses),
               prepare=_payload_with_events(prepare_payload, supervisor,
-                                           prior_events))
+                                           prior_events),
+              pilot=getattr(supervisor, "last_pilot", None))
 
 
 def _write_obs_outputs(trace_path, metrics_path, telemetry=None) -> None:
@@ -808,6 +833,8 @@ def _main(argv=None, sp_run=None) -> int:
         # graftstep opt-in repulsion amortization (env-only knob, like
         # TSNE_ATTRACTION_KERNEL; default 1 = exact cadence)
         repulsion_stride=env_int("TSNE_REPULSION_STRIDE"),
+        # graftpilot: flag or env arms the KL-guarded controller
+        autopilot=bool(args.autopilot) or env_bool("TSNE_AUTOPILOT"),
     )
 
     # static plan audit BEFORE any expensive stage: the whole point is
@@ -861,8 +888,8 @@ def _main(argv=None, sp_run=None) -> int:
             # --healthCheck/--telemetry need the segmented form: the
             # sentinel flag and the telemetry trace are read at segment
             # boundaries
-            start_iter, loss_carry, resume_state, _ = _load_resume(args,
-                                                                   dtype)
+            start_iter, loss_carry, resume_state, _, _ = _load_resume(args,
+                                                                      dtype)
             state, losses = pipe.run_checkpointable(
                 spmd_data, key, start_iter=start_iter, loss_carry=loss_carry,
                 resume_state=resume_state,
@@ -911,7 +938,8 @@ def _main(argv=None, sp_run=None) -> int:
     # ---- prepare stage (kNN -> beta search -> assembled P), shared with
     # bench.py / tsne_embed via utils/artifacts.prepare and artifact-cached;
     # a v2 fat checkpoint skips it entirely
-    start_iter, loss_carry, state, prep_payload = _load_resume(args, dtype)
+    start_iter, loss_carry, state, prep_payload, pilot_carry = _load_resume(
+        args, dtype)
     prior_events = None
     if args.resume:
         # v2 checkpoints carry the original run's plan audit: detect a
@@ -1025,7 +1053,8 @@ def _main(argv=None, sp_run=None) -> int:
         loss_carry=loss_carry, checkpoint_every=args.checkpointEvery,
         checkpoint_cb=_with_beat(wd, _make_checkpoint_cb(
             args, save_payload, supervisor, prior_events)),
-        extra_edges=extra_edges, telemetry=args.telemetry)
+        extra_edges=extra_edges, telemetry=args.telemetry,
+        pilot_carry=pilot_carry)
     state.y.block_until_ready()
     if args.profile:
         jax.profiler.stop_trace()

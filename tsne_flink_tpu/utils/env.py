@@ -126,6 +126,16 @@ _declare("TSNE_REPULSION_STRIDE", "int", 1,
          "buffers do not exist and the program is bit-identical to the "
          "unstrided one. >1 is an approximation; it rides every bench "
          "record as 'repulsion_stride'.")
+_declare("TSNE_AUTOPILOT", "bool", False,
+         "graftpilot closed-loop approximation autopilot "
+         "(models/autopilot.py): auto-tune the repulsion stride off the "
+         "mesh-canonical grad-norm trend and run a phase-aware FFT grid "
+         "ladder (coarse during early exaggeration), every decision "
+         "recorded as the bench-record 'policy' block and the final KL "
+         "guarded within KL_GUARDRAIL_TOL of the exact run. False "
+         "(default) keeps the program bit-identical to the "
+         "autopilot-free one. Mutually exclusive with "
+         "TSNE_REPULSION_STRIDE > 1 — arm one policy, not both.")
 
 # ---- runtime resilience (tsne_flink_tpu/runtime/) --------------------------
 _declare("TSNE_FAULT_PLAN", "str", None,
