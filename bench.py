@@ -81,6 +81,7 @@ RECORD_BASE_KEYS = (
     "knn_tiles", "audit", "degradations", "aot_cache", "memory",
     "host_calib", "fleet", "mesh", "kl", "repulsion_stride",
     "effective_seconds_per_iter", "repulsion_refreshes", "policy",
+    "serve",
 )
 
 
@@ -550,6 +551,11 @@ def main():
         "repulsion_refreshes": pilot_mod.policy_report(
             cfg, None, iterations_run=0)["repulsion_refreshes"],
         "policy": pilot_mod.policy_report(cfg, None, iterations_run=0),
+        # graftserve (scripts/serve_bench.py): the out-of-sample serving
+        # block — {qps, p50_ms, p99_ms, model_id, n_queries, ...} when a
+        # serve sweep ran against this fit's frozen map, None for a pure
+        # batch bench (this script never serves)
+        "serve": None,
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not

@@ -1,0 +1,238 @@
+"""graftserve bench: QPS + latency percentiles of the frozen-map query path.
+
+Fits a base map once (the same synthetic MNIST-like workload bench.py
+times, same data seed), freezes it (serve/model.py), then drives the
+serving daemon over a temp spool with fixed-size request files and
+reports what the ISSUE's serving record pins:
+
+* ``serve.qps`` — queries/second over the whole drain (submit -> result
+  files on disk, micro-batched through the fixed-bucket AOT executables);
+* ``serve.p50_ms`` / ``serve.p99_ms`` — per-request latency percentiles
+  from the daemon's own latency records (obs spans);
+* ``serve.sweep`` — the same drain repeated at several request sizes
+  (every size rides the SAME fixed-``bucket`` executables, so the whole
+  sweep is recompile-free — the shape throughput trades against
+  per-request latency, not against compiles);
+* ``serve.compile_seconds`` — backend compile seconds measured DURING
+  the sweep (after the one warmup transform): the warm-serving claim is
+  that this is ~0 — every request rides executables compiled before the
+  first request arrived;
+* ``quality`` — the transform-quality pin, measured by SELF-TRANSFORM:
+  re-embedding a sample of the base rows as if they were queries must
+  land them where the fit put them.  ``drift_rel`` is the median
+  position error relative to the embedding span; ``knn_recall`` is the
+  embedding-space kNN overlap between each transformed point's
+  neighborhood and its fitted position's neighborhood.  Both gate the
+  committed record via tests/test_bench_contract.py.
+
+``--smoke`` (tier-1, tests/test_serve.py) runs the same code at n=800 in
+seconds; the committed 60k record is produced by running this script
+bare: ``python scripts/serve_bench.py --out results/serve_60k_cpu.json``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+RECORD_BASE_KEYS = (
+    "metric", "unit", "backend", "devices", "n", "d", "data", "data_seed",
+    "fit_iters", "repulsion", "model_id", "aot_cache", "bucket", "iters",
+    "eta", "admission", "serve", "quality", "smoke",
+)
+
+
+def _emit(rec: dict) -> None:
+    missing = [k for k in RECORD_BASE_KEYS if k not in rec]
+    if missing:  # runtime face of the bench-record-contract rule
+        raise AssertionError(f"serve record is missing {missing}; every "
+                             "emission must spread the base dict")
+    print(json.dumps(rec), flush=True)
+
+
+def _knn_rows(y: np.ndarray, q: np.ndarray, k: int) -> np.ndarray:
+    """Exact embedding-space kNN of each query row against ``y`` (numpy —
+    the oracle side of the recall pin, not the serving path)."""
+    d2 = ((q[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    return np.argsort(d2, axis=1)[:, :k]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=60_000)
+    p.add_argument("--queries", type=int, default=2048,
+                   help="total query rows pushed through the daemon")
+    p.add_argument("--request-rows", type=int, default=256,
+                   help="rows per spooled request file (the headline "
+                   "serve block)")
+    p.add_argument("--sweep-rows", default="64,256,1024",
+                   help="comma-separated request sizes for the "
+                   "serve.sweep block ('' skips the sweep)")
+    p.add_argument("--fit-iters", type=int, default=500,
+                   help="base-map fit iterations; MUST run past the early-"
+                   "exaggeration gate (models/tsne.TsneConfig."
+                   "exaggeration_end, iteration 101) — a map frozen mid-"
+                   "exaggeration equilibrates 4x attraction the serving "
+                   "path does not apply, and self-transformed rows drift "
+                   "off their fitted positions by several neighbor "
+                   "spacings (recall ~0)")
+    p.add_argument("--bucket", type=int, default=None,
+                   help="serve micro-bucket (None = TSNE_SERVE_BUCKET)")
+    p.add_argument("--iters", type=int, default=None,
+                   help="transform iterations (None = TSNE_TRANSFORM_ITERS)")
+    p.add_argument("--eta", type=float, default=None,
+                   help="query-row step size (None = TSNE_TRANSFORM_ETA / "
+                   "the serve policy default)")
+    p.add_argument("--sample", type=int, default=256,
+                   help="base rows self-transformed for the quality pin")
+    p.add_argument("--knn-k", type=int, default=10)
+    p.add_argument("--out", default=None, help="also write the final "
+                   "record to this JSON path (atomic)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 shape: n=800, 128 queries, short fit")
+    a = p.parse_args(argv)
+    if a.smoke:
+        a.n, a.queries, a.request_rows = 800, 128, 32
+        a.fit_iters, a.sample = 150, 64  # past the exaggeration gate too
+        a.bucket = a.bucket or 32
+        a.iters = a.iters or 20
+        a.sweep_rows = "16,64"
+
+    import jax
+
+    from bench import DATA_SEED, make_data
+    from tsne_flink_tpu.models.api import TSNE
+    from tsne_flink_tpu.obs import trace as obtrace
+    from tsne_flink_tpu.serve.daemon import ServeDaemon, submit, read_result
+    from tsne_flink_tpu.serve.transform import (pick_serve_bucket,
+                                                pick_transform_eta,
+                                                pick_transform_iters,
+                                                transform)
+    from tsne_flink_tpu.utils import aot
+    from tsne_flink_tpu.utils.cache import enable_compilation_cache
+    from tsne_flink_tpu.utils.env import env_bool
+
+    if env_bool("TSNE_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    enable_compilation_cache()
+    aot.install_compile_meter()
+
+    x = make_data(a.n)
+    bucket = pick_serve_bucket(a.bucket)
+    iters = pick_transform_iters(a.iters)
+    eta = pick_transform_eta(a.eta)
+
+    # ---- the base map: one fit, then frozen ------------------------------
+    with obtrace.span("serve_bench.fit", cat="serve") as sp_fit:
+        est = TSNE(n_iter=a.fit_iters, perplexity=30.0,
+                   random_state=0).fit(x)
+    model = est.frozen_model()
+    base = {
+        "metric": "serve_qps", "unit": "q/s",
+        "backend": jax.default_backend(), "devices": jax.device_count(),
+        "n": int(a.n), "d": int(x.shape[1]),
+        "data": "synthetic-mnist-like", "data_seed": DATA_SEED,
+        "fit_iters": int(a.fit_iters), "repulsion": model.repulsion,
+        "model_id": model.model_id, "aot_cache": aot.cache_label(),
+        "bucket": bucket, "iters": iters, "eta": eta,
+        "admission": None, "serve": None, "quality": None,
+        "smoke": bool(a.smoke),
+    }
+
+    # ---- warmup: compile the three stage executables ONCE ----------------
+    rng = np.random.default_rng(DATA_SEED + 1)
+    queries = (x[rng.integers(0, a.n, a.queries)]
+               + 0.05 * rng.standard_normal((a.queries, x.shape[1]))
+               ).astype(x.dtype)
+    with obtrace.span("serve_bench.warmup", cat="serve") as sp_warm:
+        transform(model, queries[:1], bucket=bucket, iters=iters, eta=eta)
+
+    # ---- the serving drains: daemon over a temp spool --------------------
+    def drain(request_rows: int):
+        """All query rows at ``request_rows`` per request over a fresh
+        spool: (daemon summary, drain seconds, request count)."""
+        spool = tempfile.mkdtemp(prefix="tsne_serve_bench_")
+        daemon = ServeDaemon(model, spool, bucket=bucket, iters=iters,
+                             eta=eta, tick_s=0.001)
+        req_ids = []
+        for i in range(0, a.queries, request_rows):
+            rid = f"q{i:06d}"
+            submit(spool, queries[i:i + request_rows], rid)
+            req_ids.append(rid)
+        with obtrace.span("serve_bench.drain", cat="serve",
+                          request_rows=request_rows) as sp:
+            daemon.serve_forever(max_ticks=len(req_ids) + 2)
+        summary = daemon.summary()
+        assert summary["served"] == len(req_ids), summary
+        served = sum(read_result(spool, rid).shape[0] for rid in req_ids)
+        assert served == a.queries, (served, a.queries)
+        return summary, sp.seconds, len(req_ids)
+
+    c0 = aot.compile_snapshot()
+    summary, drain_seconds, n_requests = drain(a.request_rows)
+    sweep = []
+    for rows in (int(s) for s in a.sweep_rows.split(",") if s):
+        s_sum, s_sec, s_req = drain(rows)
+        sweep.append({"request_rows": rows,
+                      "qps": round(a.queries / max(s_sec, 1e-9), 2),
+                      "p50_ms": s_sum["p50_ms"],
+                      "p99_ms": s_sum["p99_ms"], "n_requests": s_req})
+    c1 = aot.compile_snapshot()
+    base["admission"] = summary["admission"]
+    base["serve"] = {
+        "qps": round(a.queries / max(drain_seconds, 1e-9), 2),
+        "p50_ms": summary["p50_ms"], "p99_ms": summary["p99_ms"],
+        "model_id": model.model_id, "n_queries": int(a.queries),
+        "n_requests": n_requests, "request_rows": int(a.request_rows),
+        "sweep": sweep,
+        "drain_seconds": round(drain_seconds, 3),
+        "warmup_seconds": round(sp_warm.seconds, 3),
+        "fit_seconds": round(sp_fit.seconds, 3),
+        # the warm-serving claim: every request of EVERY drain (headline
+        # + the request-size sweep) rode executables compiled before the
+        # first request arrived
+        "compile_seconds": round(c1["seconds"] - c0["seconds"], 3),
+    }
+
+    # ---- quality pin: self-transform of a base-row sample ----------------
+    sample = rng.choice(a.n, size=min(a.sample, a.n), replace=False)
+    y_base = np.asarray(model.y)
+    yq = transform(model, x[sample], bucket=bucket, iters=iters, eta=eta)
+    span = float(y_base.max(0).max() - y_base.min(0).min())
+    drift = np.linalg.norm(yq - y_base[sample], axis=1)
+    k = a.knn_k
+    # both sides drop the sampled row itself: the query IS a base row, so
+    # its nearest embedding neighbor is its own fitted position — counting
+    # it would deflate recall by 1/k for free
+    nn_fit = _knn_rows(y_base, y_base[sample], k + 2)
+    nn_served = _knn_rows(y_base, yq, k + 2)
+    recall = np.mean([
+        len(set(af[af != s][:k]) & set(bf[bf != s][:k])) / k
+        for s, af, bf in zip(sample, nn_fit, nn_served)])
+    base["quality"] = {
+        "sample": int(sample.size), "knn_k": k,
+        "knn_recall": round(float(recall), 4),
+        "drift_rel_median": round(float(np.median(drift)) / span, 5),
+        "drift_rel_p95": round(float(np.quantile(drift, 0.95)) / span, 5),
+        "embedding_span": round(span, 4),
+    }
+
+    rec = {**base}
+    _emit(rec)
+    if a.out:
+        from tsne_flink_tpu.utils.io import atomic_write
+
+        def write(tmp):
+            with open(tmp, "w") as f:
+                json.dump(rec, f, indent=2)
+        atomic_write(a.out, write)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,6 +100,32 @@ def test_wrap_respects_enablement(monkeypatch):
         aot.set_enabled(None)
 
 
+def test_source_fingerprint_invalidates_entry_key():
+    """graftserve satellite: the entry key folds a fingerprint of the
+    package's .py sources, so an on-disk code change is a clean AOT miss
+    instead of a stale executable silently serving old kernels (plan +
+    backend + jax version alone cannot see a kernel rewrite)."""
+    import tsne_flink_tpu
+    pkg_root = os.path.dirname(os.path.abspath(tsne_flink_tpu.__file__))
+    probe = os.path.join(pkg_root, "_aot_fp_probe.py")
+    assert not os.path.exists(probe)
+    aot.reset_source_fingerprint()
+    fp0 = aot.source_fingerprint()
+    k0 = aot.entry_key({"plan.n": 8}, label="unit")
+    assert aot.source_fingerprint() is fp0  # cached per process
+    try:
+        with open(probe, "w") as f:
+            f.write("# source-fingerprint probe (test litter if present)\n")
+        aot.reset_source_fingerprint()
+        assert aot.source_fingerprint() != fp0
+        assert aot.entry_key({"plan.n": 8}, label="unit") != k0
+    finally:
+        os.remove(probe)
+        aot.reset_source_fingerprint()
+    assert aot.source_fingerprint() == fp0
+    assert aot.entry_key({"plan.n": 8}, label="unit") == k0
+
+
 def test_plan_key_parts_cover_every_plan_field():
     from tsne_flink_tpu.analysis.audit.plan import bench_plan
     plan = bench_plan(1000, 32, backend="cpu")

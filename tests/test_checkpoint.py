@@ -222,3 +222,51 @@ def test_segmented_sharded_run_matches(tmp_path):
         st, jidx, jval, checkpoint_every=7, checkpoint_cb=lambda *a: None)
     np.testing.assert_array_equal(np.asarray(seg.y), np.asarray(full.y))
     np.testing.assert_array_equal(np.asarray(sl), np.asarray(fl))
+
+
+# ---- graftserve: the strict frozen-model read -------------------------------
+
+def _dir_digest(d):
+    import hashlib
+    out = {}
+    for name in sorted(os.listdir(d)):
+        with open(os.path.join(d, name), "rb") as f:
+            out[name] = hashlib.sha256(f.read()).hexdigest()
+    return out
+
+
+def test_load_model_is_read_only_and_verified(tmp_path):
+    """Serving reads must leave the checkpoint directory byte-identical:
+    no rotation, no tmp files, no fault hook — a daemon restarting over a
+    checkpoint can never perturb what it serves from."""
+    st, jidx, jval = problem()
+    d = os.path.join(str(tmp_path), "ckpts")
+    os.makedirs(d)
+    path = os.path.join(d, "model.npz")
+    payload = {"jidx": np.asarray(jidx), "jval": np.asarray(jval)}
+    ckpt.save(path, st, 19, np.asarray([2.0]), prepare=payload)
+    ckpt.save(path, st, 20, np.asarray([1.0]), prepare=payload)  # + rotation
+    before = _dir_digest(d)
+    assert set(before) == {"model.npz", "model.npz.1"}
+    state, it, losses, prepare, content_hash = ckpt.load_model(path)
+    assert it == 20 and len(content_hash) == 64
+    np.testing.assert_array_equal(state.y, np.asarray(st.y))
+    np.testing.assert_array_equal(prepare["jidx"], np.asarray(jidx))
+    np.testing.assert_array_equal(losses, np.asarray([1.0]))
+    assert _dir_digest(d) == before  # byte-identical directory
+
+
+def test_load_model_refuses_v1_and_hashless_files(tmp_path):
+    import pytest
+    st, _, _ = problem()
+    arrays = dict(y=np.asarray(st.y), update=np.asarray(st.update),
+                  gains=np.asarray(st.gains), next_iter=3,
+                  losses=np.asarray([0.1]))
+    v1 = os.path.join(str(tmp_path), "v1.npz")
+    np.savez(v1, magic=ckpt.MAGIC_V1, **arrays)
+    with pytest.raises(ckpt.NotACheckpoint, match="not a v2 checkpoint"):
+        ckpt.load_model(v1)
+    hashless = os.path.join(str(tmp_path), "nohash.npz")
+    np.savez(hashless, magic=ckpt.MAGIC, **arrays)
+    with pytest.raises(ckpt.NotACheckpoint, match="no content hash"):
+        ckpt.load_model(hashless)

@@ -1,0 +1,374 @@
+"""graftserve (ISSUE 14): out-of-sample transform() + the embed daemon.
+
+Acceptance contracts, all CPU-only:
+
+* transform determinism — the query path has no RNG and a PER-ROW
+  partition term, so one batch of queries is bit-identical to any
+  external split of the same rows (aligned or ragged), across processes
+  through the warm AOT cache, and across host device counts;
+* the daemon's coalesced micro-batch serving is bit-identical to direct
+  per-request transforms, and the spool is left clean (results + latency
+  records only — no request/lock/tmp litter);
+* chaos: ``kill@serve:seg0`` SIGKILLs the daemon AFTER computing a
+  request but BEFORE its result write; the restarted daemon breaks the
+  orphaned claim lock (TSNE_LOCK_STALE_S) and re-serves the request
+  bit-identically to a direct in-process transform;
+* admission: a daemon whose predicted transform peak exceeds the budget
+  refuses to go warm (predict-then-commit, same as the fleet scheduler);
+* ``scripts/serve_bench.py --smoke`` emits the full serving record the
+  committed 60k pin is made of.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+from tsne_flink_tpu.models.tsne import TsneState
+from tsne_flink_tpu.runtime.fleet import ServeSpec
+from tsne_flink_tpu.serve.daemon import (ServeDaemon, pick_spool,
+                                         read_result, submit)
+from tsne_flink_tpu.serve.model import from_arrays, load_frozen
+from tsne_flink_tpu.serve.transform import transform
+from tsne_flink_tpu.utils import checkpoint as ckpt
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+N, D, M = 96, 6, 2
+
+
+def _tiny_model(n=N, d=D, repulsion="exact", seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((n, M))).astype(np.float32)
+    plan = PlanConfig(n=n, d=d, k=12, backend="cpu", repulsion=repulsion,
+                      name="serve-test")
+    return x, from_arrays(x, y, plan, perplexity=4.0, learning_rate=100.0)
+
+
+def _queries(rows, d=D, seed=9):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((rows, d)).astype(np.float32)
+
+
+# ---- transform determinism --------------------------------------------------
+
+@pytest.mark.parametrize("repulsion", ["exact", "fft"])
+def test_transform_batch_split_bit_identical(repulsion):
+    """One batch == any external split of the same rows (per-row Z, no
+    RNG), on both serving repulsion paths — including a ragged split
+    whose second piece rides a partially padded bucket."""
+    _, model = _tiny_model(repulsion=repulsion)
+    assert model.repulsion == repulsion
+    q = _queries(48)
+    whole = transform(model, q, bucket=16, iters=8)
+    assert whole.shape == (48, M) and np.isfinite(whole).all()
+    aligned = np.concatenate([transform(model, q[s:s + 16], bucket=16,
+                                        iters=8) for s in range(0, 48, 16)])
+    np.testing.assert_array_equal(whole, aligned)
+    ragged = np.concatenate([transform(model, q[:30], bucket=16, iters=8),
+                             transform(model, q[30:], bucket=16, iters=8)])
+    np.testing.assert_array_equal(whole, ragged)
+
+
+def test_transform_validates_queries_and_handles_empty():
+    _, model = _tiny_model()
+    with pytest.raises(ValueError, match="queries must be"):
+        transform(model, np.zeros((4, D + 1), np.float32), bucket=8, iters=2)
+    with pytest.raises(ValueError, match="queries must be"):
+        transform(model, np.zeros(D, np.float32), bucket=8, iters=2)
+    out = transform(model, np.zeros((0, D), np.float32), bucket=8, iters=2)
+    assert out.shape == (0, M)
+
+
+def test_estimator_transform_requires_fit_and_is_deterministic():
+    from tsne_flink_tpu.models.api import TSNE
+    with pytest.raises(RuntimeError, match="fit"):
+        TSNE().transform(np.zeros((2, 3), np.float32))
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((72, D)).astype(np.float32)
+    est = TSNE(n_iter=12, perplexity=5.0, random_state=0).fit(x)
+    assert est.frozen_model() is est.frozen_model()  # one freeze per fit
+    q = _queries(9, seed=2)
+    y1 = est.transform(q, bucket=8, iters=4)
+    assert y1.shape == (9, M)
+    np.testing.assert_array_equal(y1, est.transform(q, bucket=8, iters=4))
+
+
+_XPROC = r"""
+import hashlib, json, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tsne_flink_tpu.utils import aot
+aot.install_compile_meter()
+from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+from tsne_flink_tpu.serve.model import from_arrays
+from tsne_flink_tpu.serve.transform import transform
+rng = np.random.default_rng(7)
+x = rng.standard_normal((96, 6)).astype(np.float32)
+y = (0.1 * rng.standard_normal((96, 2))).astype(np.float32)
+q = rng.standard_normal((20, 6)).astype(np.float32)
+plan = PlanConfig(n=96, d=6, k=12, backend="cpu", repulsion="exact",
+                  name="serve-xproc")
+model = from_arrays(x, y, plan, perplexity=4.0, learning_rate=100.0)
+out = transform(model, q, bucket=16, iters=8)
+print(json.dumps({"sha": hashlib.sha256(out.tobytes()).hexdigest(),
+                  "devices": jax.device_count(),
+                  "aot": aot.stats(), "label": aot.cache_label()}))
+"""
+
+
+def _run_xproc(env):
+    r = subprocess.run([sys.executable, "-c", _XPROC % {"repo": REPO}],
+                       capture_output=True, text=True, env=env, cwd=REPO,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_transform_cross_process_warm_aot_bit_identical(tmp_path):
+    """Cold process compiles + persists the three serve stage executables;
+    a warm process loads all three (zero compiles) and produces the same
+    bytes — the restarted-daemon determinism claim."""
+    env = dict(os.environ, TSNE_AOT_DIR=str(tmp_path), TSNE_AOT_CACHE="1",
+               TSNE_ARTIFACTS="0", JAX_PLATFORMS="cpu",
+               TSNE_TPU_CACHE_DIR=str(tmp_path / "xla"))
+    cold, warm = _run_xproc(env), _run_xproc(env)
+    assert cold["sha"] == warm["sha"]
+    assert cold["aot"]["misses"] >= 3        # knn / init / optimize
+    assert warm["aot"]["misses"] == 0
+    assert warm["aot"]["hits"] >= 3
+    assert warm["aot"]["compile_seconds"] == 0.0
+    assert warm["label"] == "warm"
+
+
+def test_transform_device_count_independent(tmp_path):
+    """The query path is replicated row math — no mesh collective exists
+    to reorder a reduction — so 1 visible device and 4 produce the same
+    bytes."""
+    shas = []
+    for dev in (1, 4):
+        env = dict(os.environ, TSNE_AOT_CACHE="0", TSNE_ARTIFACTS="0",
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=f"--xla_force_host_platform_device_count={dev}")
+        rec = _run_xproc(env)
+        assert rec["devices"] == dev
+        shas.append(rec["sha"])
+    assert shas[0] == shas[1]
+
+
+# ---- the daemon -------------------------------------------------------------
+
+def test_daemon_coalesced_serving_matches_direct(tmp_path):
+    _, model = _tiny_model()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    q1, q2 = _queries(10, seed=1), _queries(23, seed=2)
+    submit(spool, q1, "a")
+    submit(spool, q2, "b")
+    d = ServeDaemon(model, spool, bucket=16, iters=8, tick_s=0.001)
+    assert d.admission["peak_bytes"] > 0
+    summary = d.serve_forever(max_ticks=3)
+    assert summary["served"] == 2
+    assert summary["p50_ms"] > 0 and summary["p99_ms"] >= summary["p50_ms"]
+    np.testing.assert_array_equal(read_result(spool, "a"),
+                                  transform(model, q1, bucket=16, iters=8))
+    np.testing.assert_array_equal(read_result(spool, "b"),
+                                  transform(model, q2, bucket=16, iters=8))
+    # clean spool: results + latency records only — requests deleted, no
+    # lock or tmp litter
+    assert sorted(os.listdir(spool)) == ["a.lat.json", "a.res.npz",
+                                         "b.lat.json", "b.res.npz"]
+    with open(os.path.join(spool, "a.lat.json")) as f:
+        lat = json.load(f)
+    assert lat["req"] == "a" and lat["rows"] == 10
+    assert lat["model_id"] == model.model_id and lat["seconds"] > 0
+
+
+def test_daemon_idle_exit_and_spool_validation(tmp_path, monkeypatch):
+    monkeypatch.delenv("TSNE_SERVE_SPOOL", raising=False)
+    with pytest.raises(ValueError, match="spool"):
+        pick_spool(None)
+    monkeypatch.setenv("TSNE_SERVE_SPOOL", str(tmp_path))
+    assert pick_spool() == str(tmp_path)
+    _, model = _tiny_model(n=32)
+    d = ServeDaemon(model, bucket=8, iters=2, tick_s=0.001,
+                    idle_exit_s=0.01)
+    assert d.spool == str(tmp_path)
+    summary = d.serve_forever()  # no max_ticks: returns via idle-exit
+    assert summary["served"] == 0 and summary["p50_ms"] == 0.0
+
+
+def test_daemon_admission_refusal(tmp_path):
+    """Predict-then-commit: an impossible budget refuses BEFORE any
+    compile (the graftcheck transform-stage peak is the unit)."""
+    _, model = _tiny_model(n=32)
+    with pytest.raises(RuntimeError, match="serve admission"):
+        ServeDaemon(model, str(tmp_path), bucket=8, iters=2, budget_bytes=1)
+
+
+def test_submit_rejects_non_matrix(tmp_path):
+    with pytest.raises(ValueError, match="request must be"):
+        submit(str(tmp_path), np.zeros(4, np.float32), "bad")
+
+
+# ---- frozen-model loading ---------------------------------------------------
+
+def _save_frozen_fixture(tmp_path, n=64, d=5, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((n, M))).astype(np.float32)
+    st = TsneState(y=jnp.asarray(y),
+                   update=jnp.zeros_like(jnp.asarray(y)),
+                   gains=jnp.ones_like(jnp.asarray(y)))
+    model_path = os.path.join(str(tmp_path), "model.npz")
+    ckpt.save(model_path, st, 10, np.asarray([0.5]))
+    input_path = os.path.join(str(tmp_path), "x.npy")
+    np.save(input_path, x)
+    return x, y, model_path, input_path
+
+
+def test_load_frozen_identity_and_base_mismatch(tmp_path):
+    x, y, model_path, _ = _save_frozen_fixture(tmp_path)
+    plan = PlanConfig(n=64, d=5, k=8, backend="cpu", repulsion="exact",
+                      name="serve-load")
+    model = load_frozen(model_path, x, plan, perplexity=4.0,
+                        learning_rate=100.0)
+    np.testing.assert_array_equal(np.asarray(model.y), y)
+    assert model.ckpt_hash and len(model.model_id) == 16
+    with pytest.raises(ValueError, match="same dataset"):
+        load_frozen(model_path, x[:-1], plan)
+
+
+def test_cli_transform_route_end_to_end(tmp_path):
+    """--model/--transform: fit once with --fatCheckpoint, then embed
+    query rows into the frozen map through the full argument parser —
+    no fit, no checkpoint rotation on the serve run."""
+    from tsne_flink_tpu.utils.cli import main as cli_main
+
+    def write_coo(path, x):
+        with open(path, "w") as f:
+            for i in range(x.shape[0]):
+                for j in range(x.shape[1]):
+                    f.write(f"{i},{j},{float(x[i, j])!r}\n")
+
+    tmp = str(tmp_path)
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((40, 6))
+    q = rng.standard_normal((7, 6))
+    base_csv = os.path.join(tmp, "base.csv")
+    query_csv = os.path.join(tmp, "queries.csv")
+    write_coo(base_csv, x)
+    write_coo(query_csv, q)
+    ckpt_path = os.path.join(tmp, "model.npz")
+    rc = cli_main(["--input", base_csv, "--output",
+                   os.path.join(tmp, "fit.csv"), "--dimension", "6",
+                   "--knnMethod", "bruteforce", "--perplexity", "5",
+                   "--iterations", "30", "--checkpoint", ckpt_path,
+                   "--fatCheckpoint"])
+    assert rc == 0
+    ckpt_bytes = open(ckpt_path, "rb").read()
+    out_csv = os.path.join(tmp, "q_out.csv")
+    rc = cli_main(["--input", base_csv, "--model", ckpt_path,
+                   "--transform", query_csv, "--output", out_csv,
+                   "--dimension", "6", "--knnMethod", "bruteforce",
+                   "--perplexity", "5", "--repulsion", "exact"])
+    assert rc == 0
+    rows = np.loadtxt(out_csv, delimiter=",", ndmin=2)
+    assert rows.shape == (7, 3)  # id + 2 components
+    assert np.isfinite(rows).all()
+    # the serve read was side-effect-free: same checkpoint bytes after
+    assert open(ckpt_path, "rb").read() == ckpt_bytes
+    with pytest.raises(SystemExit):  # --transform without --model
+        cli_main(["--input", base_csv, "--transform", query_csv,
+                  "--output", out_csv, "--dimension", "6"])
+
+
+# ---- chaos: kill mid-request, restart, bit-identical re-serve ---------------
+
+def test_daemon_chaos_kill_midrequest_then_bitidentical_reserve(tmp_path):
+    """``kill@serve:seg0`` SIGKILLs the daemon after computing request 0
+    but before its result write.  The spool then holds the intact request
+    plus the orphaned claim lock; a restarted daemon breaks the stale
+    lock, re-serves bit-identically to a direct transform, and leaves no
+    litter."""
+    x, _, model_path, input_path = _save_frozen_fixture(tmp_path)
+    spool = os.path.join(str(tmp_path), "spool")
+    os.makedirs(spool)
+    q = _queries(11, d=5, seed=4)
+    submit(spool, q, "r0")
+    record_path = os.path.join(str(tmp_path), "serve_record.json")
+    spec = ServeSpec(name="chaos", model=model_path, input=input_path,
+                     spool=spool, record=record_path, perplexity=4.0,
+                     learning_rate=100.0, neighbors=8, repulsion="exact",
+                     bucket=16, iters=6, max_ticks=8,
+                     fault_plan="kill@serve:seg0")
+    spec_path = spec.save(os.path.join(str(tmp_path), "serve.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TSNE_ARTIFACTS="0",
+               TSNE_AOT_CACHE="0", TSNE_SERVE_TICK_S="0.01",
+               TSNE_LOCK_STALE_S="0.05")
+    cmd = [sys.executable, "-m", "tsne_flink_tpu.runtime.fleet",
+           "--serve", spec_path]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=300)
+    assert r.returncode == -9, (r.returncode, r.stderr[-2000:])
+    assert os.path.exists(os.path.join(spool, "r0" + ".req.npz"))
+    assert read_result(spool, "r0") is None
+    assert os.path.exists(os.path.join(spool, "r0.req.npz.lock"))
+
+    time.sleep(0.1)  # age the orphaned claim past TSNE_LOCK_STALE_S
+    spec.fault_plan = None
+    spec.save(spec_path)
+    r2 = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                        cwd=REPO, timeout=300)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    got = read_result(spool, "r0")
+    assert got is not None
+    plan = PlanConfig(n=64, d=5, k=8, backend="cpu", repulsion="exact",
+                      name="chaos-direct")
+    model = load_frozen(model_path, x, plan, perplexity=4.0,
+                        learning_rate=100.0)
+    np.testing.assert_array_equal(
+        got, transform(model, q, bucket=16, iters=6))
+    assert sorted(os.listdir(spool)) == ["r0.lat.json", "r0.res.npz"]
+    with open(record_path) as f:
+        rec = json.load(f)
+    assert rec["status"] == "ok" and rec["served"] == 1
+    assert rec["model_id"] == model.model_id
+    assert rec["p50_ms"] > 0
+
+
+# ---- the serving bench ------------------------------------------------------
+
+def test_serve_bench_smoke_emits_contract_record(tmp_path):
+    """``--smoke`` runs the full 60k-record code path in seconds: fit,
+    freeze, daemon sweep, quality self-transform — and every emitted
+    field the committed record's pin reads must be present and sane."""
+    out_path = tmp_path / "serve_smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TSNE_FORCE_CPU="1",
+               TSNE_ARTIFACTS="0", TSNE_AOT_CACHE="0", TSNE_TRACE="0")
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "scripts", "serve_bench.py"),
+                        "--smoke", "--out", str(out_path)],
+                       capture_output=True, text=True, env=env, cwd=REPO,
+                       timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out_path.read_text())
+    assert rec["smoke"] is True and rec["metric"] == "serve_qps"
+    serve = rec["serve"]
+    assert serve["qps"] > 0 and serve["n_queries"] == 128
+    assert serve["p99_ms"] >= serve["p50_ms"] > 0
+    assert serve["model_id"] == rec["model_id"]
+    assert serve["compile_seconds"] == 0.0  # warm drain: zero recompiles
+    assert rec["admission"]["peak_bytes"] > 0
+    q = rec["quality"]
+    assert q["knn_recall"] >= 0.3  # smoke floor; the 60k pin is tighter
+    assert q["drift_rel_median"] <= 0.05

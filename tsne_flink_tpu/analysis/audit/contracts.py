@@ -235,6 +235,37 @@ contract("ops.repulsion_fft.fft_repulsion",
          "tsne_flink_tpu/ops/repulsion_fft.py", ("float32", "float32"),
          _mk_fft)
 
+
+# ---- graftserve query path (the serve/transform.py jit stages) --------------
+
+def _mk_knn_queries():
+    from tsne_flink_tpu.ops.knn import knn_queries
+    return (lambda q, x: knn_queries(q, x, K), (_f32(64, D), _f32(N, D)))
+
+
+def _mk_fft_base_field():
+    from tsne_flink_tpu.ops.repulsion_fft import fft_base_field
+    return (lambda y: fft_base_field(y, grid=32).pot, (_f32(N, M),))
+
+
+def _mk_fft_field_repulsion():
+    from tsne_flink_tpu.ops.repulsion_fft import (FftField,
+                                                  fft_field_repulsion)
+    g = 32
+    return (lambda pot, h, origin, y: fft_field_repulsion(
+        FftField(pot=pot, h=h, origin=origin, grid=g, interp=3), y),
+        (_f32(2 + M, g ** M), _f32(), _f32(M), _f32(64, M)))
+
+
+contract("ops.knn.knn_queries", "tsne_flink_tpu/ops/knn.py",
+         ("int32", "float32"), _mk_knn_queries, matmul_dim=D)
+contract("ops.repulsion_fft.fft_base_field",
+         "tsne_flink_tpu/ops/repulsion_fft.py", ("float32",),
+         _mk_fft_base_field)
+contract("ops.repulsion_fft.fft_field_repulsion",
+         "tsne_flink_tpu/ops/repulsion_fft.py", ("float32", "float32"),
+         _mk_fft_field_repulsion)
+
 # Mosaic Pallas kernel: declared-only (trace=False) — its lowering is
 # hardware-gated and probed at runtime (ops/repulsion_pallas.mosaic_supported);
 # the XLA exact path above carries the same contract everywhere else.

@@ -276,10 +276,67 @@ def _optimize_stage(plan: PlanConfig) -> dict:
     return terms
 
 
+def _transform_stage(plan: PlanConfig) -> dict:
+    """graftserve: the daemon's steady state — the frozen model is
+    RESIDENT for the process lifetime (base X + embedding + betas'
+    worth of prepare arrays, plus the precomputed FFT base field when
+    the serve plan resolves to fft repulsion), and each micro-bucket of
+    ``plan.serve_queries`` rows adds the query-path transients: the
+    cross-set distance tile, the [B, k] graph + directed P, the query
+    working set, and the per-iteration attraction/repulsion tiles."""
+    n, d, k, m, isz = (plan.n, plan.d, plan.k, plan.n_components,
+                       plan.itemsize)
+    b = int(plan.serve_queries)
+    rep = plan.resolved_repulsion()
+    terms: dict[str, float] = {"repulsion": rep}
+    # frozen model: base X + base Y + the [N, k] graph kept for model_id/
+    # interpolation provenance (fat-checkpoint prepare arrays)
+    model = float(n * d * isz + n * m * isz + n * k * (4 + isz))
+    if rep == "fft":
+        from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
+        g = DEFAULT_GRID.get(m, 1024)
+        # precomputed potential volumes: (2 + m) channels at G^m (K1·1
+        # for per-row Z, K2·[1, y] for the force), real space only — the
+        # spectra are build-time transients, freed before serving
+        model += float((2 + m) * g ** m * isz)
+    terms["model"] = model
+    from tsne_flink_tpu.ops.knn_tiles import pick_knn_tiles
+    tiles = pick_knn_tiles(max(b, 1), d, k, plan.backend)
+    c = min(tiles.row_chunk, max(b, 1))
+    terms["knn_tile"] = PIPELINE_FACTOR * c * n * isz  # [c, N] query sweep
+    # query working set: x_q, (y, update, gains), graph + directed P
+    terms["queries"] = float(b * d * isz + 3.0 * b * m * isz
+                             + b * k * (4 + 2.0 * isz))
+    # per-iteration tiles: width-k CSR-head attraction + the repulsion
+    # sweep against the frozen base ([B, N] exact tile; the fft field
+    # path only gathers, bounded by the same term)
+    attr = PIPELINE_FACTOR * min(plan.row_chunk, max(b, 1)) * k * (
+        m * isz + 4.0 * isz)
+    rep_tile = (0.0 if rep == "fft"
+                else PIPELINE_FACTOR * min(plan.row_chunk, max(b, 1)) * n
+                * isz)
+    terms["attraction"] = attr
+    terms["repulsion_tile"] = rep_tile
+    terms["peak"] = (model + terms["knn_tile"] + terms["queries"] + attr
+                     + rep_tile)
+    return terms
+
+
+def transform_peak_bytes(plan: PlanConfig) -> int:
+    """The serving stage's peak in BYTES (the daemon's admission unit —
+    the report rounds stage terms to GiB for humans, but a serve process
+    runs only the transform stage and admits against the exact number)."""
+    return int(_transform_stage(plan)["peak"])
+
+
 def plan_hbm_report(plan: PlanConfig) -> dict:
     """Per-stage peak-HBM estimates + the plan-level verdict."""
     stages = {"knn": _knn_stage(plan), "affinities": _affinity_stage(plan),
               "optimize": _optimize_stage(plan)}
+    if int(getattr(plan, "serve_queries", 0)) > 0:
+        # graftserve: only serving plans grow the stage map — a batch
+        # plan's report (and every committed fixture) is unchanged
+        stages["transform"] = _transform_stage(plan)
     peak_stage = max(stages, key=lambda st: stages[st]["peak"])
     peak = stages[peak_stage]["peak"]
     budget = plan.hbm_budget()

@@ -80,6 +80,14 @@ class PlanConfig:
     #: carried (rep, Z) pair the stride controller refreshes, and the
     #: controller state/policy-trace carry.
     autopilot: bool = False
+    #: graftserve: query rows per transform micro-bucket when this plan
+    #: describes a SERVING process (0 = batch fit, no transform stage).
+    #: With it set, the HBM model adds a ``transform`` stage whose live
+    #: set counts the frozen model as RESIDENT (base X + embedding + the
+    #: precomputed FFT field all stay on device for the daemon's
+    #: lifetime) plus the per-bucket query transients — the admission
+    #: number graftfleet charges a daemon against.
+    serve_queries: int = 0
     name: str = "plan"
 
     def __post_init__(self):

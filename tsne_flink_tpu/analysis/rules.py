@@ -627,6 +627,11 @@ CLI_ONLY_FLAGS = {
     # (the watchdog os._exit()s the caller); env twins TSNE_JOB_TIMEOUT /
     # TSNE_STAGE_TIMEOUT
     "jobTimeout", "stageTimeout",
+    # graftserve: the serve route is a METHOD on the estimator
+    # (TSNE.transform / TSNE.frozen_model), not a constructor kwarg — the
+    # CLI spells the same capability as file paths (--model the frozen
+    # checkpoint, --transform the query rows)
+    "model", "transform",
 }
 
 #: estimator-only kwargs with no CLI counterpart (none at present; the
@@ -1315,6 +1320,7 @@ _RECORD_KEYS_FALLBACK = (
     "knn_tiles", "audit", "degradations", "aot_cache", "memory",
     "host_calib", "fleet", "mesh", "kl", "repulsion_stride",
     "effective_seconds_per_iter", "repulsion_refreshes", "policy",
+    "serve",
 )
 
 #: record keys that describe the WORKLOAD, not a resolved decision —

@@ -163,6 +163,8 @@ class TSNE:
         # ``metrics_["policy"]`` after fit.
         self.autopilot = autopilot
         self.embedding_ = None
+        self._fit_x = None
+        self._frozen = None
         self.kl_divergence_ = None
         self.kl_trace_ = None
         self.runtime_events_ = None
@@ -402,7 +404,50 @@ class TSNE:
         self.kl_trace_ = np.asarray(losses)
         self.kl_divergence_ = (float(self.kl_trace_[-1])
                                if self.kl_trace_.size else float("nan"))
+        # graftserve: retain the inputs so transform() can freeze this fit
+        # (numpy copy — the device buffer is free to be donated/deleted)
+        self._fit_x = np.asarray(x)
+        self._frozen = None
         return self
 
     def fit_transform(self, x, y=None) -> np.ndarray:
         return self.fit(x).embedding_
+
+    def frozen_model(self):
+        """This fit as a :class:`~tsne_flink_tpu.serve.model.FrozenModel`
+        (built on first use, cached on the estimator) — the object the
+        serve daemon and ``transform`` answer queries from."""
+        if self.embedding_ is None or getattr(self, "_fit_x", None) is None:
+            raise RuntimeError("transform() requires a fitted estimator — "
+                               "call fit() first")
+        if getattr(self, "_frozen", None) is None:
+            from tsne_flink_tpu.runtime.supervisor import run_plan_from_fit
+            from tsne_flink_tpu.serve.model import from_arrays
+            n, d = self._fit_x.shape
+            cfg = self._config(n)
+            k = (self.neighbors if self.neighbors is not None
+                 else 3 * int(cfg.perplexity))
+            plan = run_plan_from_fit(
+                n, d, k, cfg, self.affinity_assembly or "auto",
+                self.knn_method, knn_rounds=self.knn_iterations,
+                knn_refine=self.knn_refine, sym_width=self.sym_width,
+                name="estimator-serve")
+            self._frozen = from_arrays(
+                self._fit_x, self.embedding_, plan,
+                perplexity=cfg.perplexity, learning_rate=cfg.learning_rate,
+                metric=cfg.metric)
+        return self._frozen
+
+    def transform(self, x, *, bucket: int | None = None,
+                  iters: int | None = None) -> np.ndarray:
+        """Embed NEW rows into the fitted map without moving it — the
+        out-of-sample path (serve/transform.py): query→base kNN, directed
+        per-query affinities at the trained perplexity, interpolation
+        init, then a short fixed-iteration optimize of only the query
+        rows against the frozen embedding.  Deterministic: no RNG, and
+        per-row independence makes results bit-identical across batch
+        splits.  ``bucket``/``iters`` default to ``TSNE_SERVE_BUCKET`` /
+        ``TSNE_TRANSFORM_ITERS``."""
+        from tsne_flink_tpu.serve.transform import transform as _transform
+        return _transform(self.frozen_model(), x, bucket=bucket,
+                          iters=iters)

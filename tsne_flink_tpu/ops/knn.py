@@ -281,6 +281,38 @@ def _exact_final(dist, idx, n: int, k: int):
             dist.reshape(-1, k)[:n])
 
 
+def knn_queries(q: jnp.ndarray, x: jnp.ndarray, k: int,
+                metric: str = "sqeuclidean", *,
+                row_chunk: int | None = None, tiles=None):
+    """Exact cross-set kNN: each QUERY row's k nearest BASE rows.
+
+    The out-of-sample serving path (``serve/transform.py``): queries never
+    join the base set, so unlike :func:`knn_bruteforce` there is no
+    self-pair to mask and ``k`` clamps to ``n_base`` (not ``n - 1``).
+    Same row-chunked ``‖a‖²+‖b‖²−2abᵀ`` tiles + ``lax.top_k`` as the
+    in-sample exact sweep — one MXU tile row per query chunk — with the
+    chunk width resolved through the same tile plan
+    (``ops/knn_tiles.pick_knn_tiles``), so a query sweep obeys the same
+    HBM transient bound the audit models.  Returns
+    ``(idx int32 [B, k], dist [B, k])``, rows ascending by distance."""
+    nb, dim = x.shape
+    nq = q.shape[0]
+    k = int(min(k, nb))
+    if row_chunk is None:
+        tiles = _resolve_tiles(tiles, max(nq, 1), dim, k)
+        row_chunk = tiles.row_chunk
+    c = min(row_chunk, nq)
+    nchunks = math.ceil(nq / c)
+    qp = jnp.pad(q, ((0, nchunks * c - nq), (0, 0)))
+
+    def one_chunk(qc):
+        dmat = pairwise(metric, qc, x)  # [c, nb]
+        return _topk_smallest(dmat, k)
+
+    dist, idx = lax.map(one_chunk, qp.reshape(nchunks, c, dim))
+    return _exact_final(dist, idx, nq, k)
+
+
 def knn_partition(x: jnp.ndarray, k: int, metric: str = "sqeuclidean",
                   blocks: int = 8, *, row_chunk: int | None = None,
                   tiles=None, kernel: str | None = None):

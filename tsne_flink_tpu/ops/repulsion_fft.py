@@ -220,3 +220,121 @@ def fft_repulsion(y: jnp.ndarray, y_full: jnp.ndarray | None = None, *,
 
     rep = (y * phi_f[0][:, None] - phi_f[1:].T) * y_loc_w[:, None]
     return rep, z_global
+
+
+class FftField(NamedTuple):
+    """graftserve: the FROZEN base's repulsion field, precomputed once at
+    model load (serve/model.py) — the convolution side of the FIt-SNE
+    construction with the dynamic inputs fixed.  A frozen embedding fixes
+    the bounding box, hence ``h``/``origin``, hence the kernel tables AND
+    the spread+convolve of the base charges: per query batch only the
+    order-p Lagrange gather at the query positions remains
+    (:func:`fft_field_repulsion`).
+
+    ``pot`` holds ``2 + m`` real-space potential volumes ``[2+m, G^m]``:
+    row 0 is ``K1 ⊛ 1`` (the PER-ROW partition term ``Z_i = Σ_j K1(y_i -
+    y_j)`` — queries are not base points, so no self-term correction),
+    row 1 is ``K2 ⊛ 1`` and rows 2.. are ``K2 ⊛ y_d`` (the force
+    decomposition in the module docstring)."""
+
+    pot: jnp.ndarray      # [2+m, G^m]
+    h: jnp.ndarray        # node spacing (scalar)
+    origin: jnp.ndarray   # [m] grid origin
+    grid: int
+    interp: int
+
+
+def fft_base_field(y_base: jnp.ndarray, *, grid: int | None = None,
+                   interp: int = 3, geom: FftGeom | None = None) -> FftField:
+    """Spread + FFT-convolve the frozen base's charges once; returns the
+    gatherable :class:`FftField`.  The spectra are build-time transients —
+    only the ``[2+m, G^m]`` real-space potentials persist."""
+    nfull, m = y_base.shape
+    dtype = y_base.dtype
+    if geom is None:
+        geom = fft_geometry(m, grid, dtype)
+    g = geom.grid
+    p = interp
+    half_sten = (p - 1) // 2
+    nch = 1 + m
+
+    lo = jnp.min(y_base, axis=0)
+    hi = jnp.max(y_base, axis=0)
+    side = jnp.maximum(jnp.max(hi - lo), jnp.asarray(1e-6, dtype))
+    h = side / (g - p)
+    origin = lo - half_sten * h
+
+    u = (y_base - origin[None, :]) / h
+    idx0 = jnp.clip(jnp.floor(u).astype(jnp.int32),
+                    half_sten, g - p + half_sten)
+    frac = u - idx0
+    wdim = _lagrange_weights(frac, p)
+    base = idx0 - half_sten
+
+    charges = jnp.concatenate([jnp.ones((nfull, 1), dtype), y_base], axis=1)
+    offs_w, offs_flat = [], []
+    for offs in itertools.product(range(p), repeat=m):
+        w = jnp.ones((nfull,), dtype)
+        flat = jnp.zeros((nfull,), jnp.int32)
+        for d in range(m):
+            w = w * wdim[:, d, offs[d]]
+            flat = flat * g + (base[:, d] + offs[d])
+        offs_w.append(w)
+        offs_flat.append(flat)
+    upd = jnp.concatenate([charges * w[:, None] for w in offs_w], axis=0)
+    flat_all = jnp.concatenate(offs_flat)
+    grid_ch = jax.ops.segment_sum(upd, flat_all, num_segments=g**m)
+    gridf = grid_ch.T.reshape((nch,) + (g,) * m)
+
+    k1 = 1.0 / (1.0 + (h * h) * geom.rho2)
+    k2 = k1 * k1
+    axes = tuple(range(1, m + 1))
+    khat = jnp.fft.rfftn(jnp.stack([k1, k2]), axes=axes)
+    pad_widths = [(0, 0)] + [(0, g)] * m
+    ghat = jnp.fft.rfftn(jnp.pad(gridf, pad_widths), axes=axes)
+    # channel stack: unit charge under K1, then every charge under K2
+    chat = jnp.concatenate([ghat[:1] * khat[0], ghat * khat[1]], axis=0)
+    conv = jnp.fft.irfftn(chat, axes=axes, s=(2 * g,) * m)
+    sl = (slice(None),) + tuple(slice(0, g) for _ in range(m))
+    pot = conv[sl].reshape(2 + m, -1)
+    return FftField(pot=pot, h=h, origin=origin, grid=g, interp=p)
+
+
+def fft_field_repulsion(field: FftField, y: jnp.ndarray):
+    """Repulsion of query rows ``y`` against the frozen base behind
+    ``field``: the order-p Lagrange gather of the precomputed potentials
+    at the query positions — O(B p^m), no FFT, no base traffic.
+
+    Returns ``(rep [B, m], z_row [B])`` with ``z_row`` the per-row
+    partition term (queries optimize independently, so the serving
+    gradient normalizes per row — serve/transform.py).  Query positions
+    are clamped to the field's stencil-valid range before interpolation:
+    in-grid queries evaluate exactly as :func:`fft_repulsion` would,
+    strays read the boundary value instead of extrapolating."""
+    nloc, m = y.shape
+    dtype = y.dtype
+    g, p = field.grid, field.interp
+    half_sten = (p - 1) // 2
+    u = (y - field.origin[None, :]) / field.h
+    # clamp BEFORE floor: a stray's fractional offset stays in [0, 1), so
+    # the Lagrange basis interpolates instead of extrapolating
+    u = jnp.clip(u, jnp.asarray(half_sten, dtype),
+                 jnp.asarray(g - p + half_sten + 0.999999, dtype))
+    idx0 = jnp.clip(jnp.floor(u).astype(jnp.int32),
+                    half_sten, g - p + half_sten)
+    frac = u - idx0
+    wdim = _lagrange_weights(frac, p)
+    base = idx0 - half_sten
+
+    phi = jnp.zeros((2 + m, nloc), dtype)
+    for offs in itertools.product(range(p), repeat=m):
+        w = jnp.ones((nloc,), dtype)
+        flat = jnp.zeros((nloc,), jnp.int32)
+        for d in range(m):
+            w = w * wdim[:, d, offs[d]]
+            flat = flat * g + (base[:, d] + offs[d])
+        phi = phi + w[None, :] * field.pot[:, flat]
+
+    z_row = phi[0]
+    rep = y * phi[1][:, None] - phi[2:].T
+    return rep, z_row

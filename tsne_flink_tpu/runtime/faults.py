@@ -50,7 +50,7 @@ import signal
 from dataclasses import dataclass, field
 
 KINDS = ("oom", "kill", "corrupt", "nan", "delay")
-SITES = ("knn", "affinities", "optimize", "checkpoint", "job")
+SITES = ("knn", "affinities", "optimize", "checkpoint", "job", "serve")
 
 #: where in a segment each optimize-site kind fires: oom/nan/delay at
 #: segment start (so the recovery path sees the failure before any work

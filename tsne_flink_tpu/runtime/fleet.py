@@ -324,13 +324,128 @@ def run_job(spec: JobSpec) -> dict:
     return record
 
 
+# ---- daemon mode (graftserve) ----------------------------------------------
+
+@dataclass
+class ServeSpec:
+    """One embed daemon, JSON-serializable — the fleet<->daemon contract
+    (graftserve's analog of :class:`JobSpec`)."""
+
+    name: str
+    model: str                     # fat v2 checkpoint (the frozen map)
+    input: str                     # [n, d] base features, .npy
+    spool: str                     # request spool directory
+    record: str = ""               # serving-summary JSON (written at exit)
+    perplexity: float = 10.0
+    learning_rate: float = 1000.0
+    metric: str = "sqeuclidean"
+    neighbors: int | None = None   # default 3 * perplexity
+    repulsion: str = "auto"
+    bucket: int | None = None      # None = TSNE_SERVE_BUCKET
+    iters: int | None = None       # None = TSNE_TRANSFORM_ITERS
+    eta: float | None = None       # None = TSNE_TRANSFORM_ETA / policy
+    max_ticks: int | None = None   # None = run until idle-exit/kill
+    x64: bool = False
+    fault_plan: str | None = None
+    job_timeout: float | None = None
+    stage_timeout: float | None = None
+
+    def k(self) -> int:
+        return (int(self.neighbors) if self.neighbors is not None
+                else 3 * int(self.perplexity))
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServeSpec":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.as_dict(), f, indent=2)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ServeSpec":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+
+def run_serve(spec: ServeSpec) -> dict:
+    """The daemon process: load the frozen model once, go warm, drain the
+    spool until idle-exit / ``max_ticks`` / a watchdog kill.  Same
+    process-level conventions as :func:`run_job` — fault plan activated
+    before any instrumented site, watchdog beating per tick (exit 124 on
+    a wedged transform), summary record written atomically at exit."""
+    import jax
+
+    from tsne_flink_tpu.utils.env import env_bool
+
+    if env_bool("TSNE_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    if spec.x64:
+        jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from tsne_flink_tpu.analysis.audit import PlanConfig
+    from tsne_flink_tpu.serve.daemon import ServeDaemon
+    from tsne_flink_tpu.serve.model import load_frozen
+
+    faults.activate(spec.fault_plan)
+    x = np.load(spec.input)
+    plan = PlanConfig(n=int(x.shape[0]), d=int(x.shape[1]), k=spec.k(),
+                      backend=jax.default_backend(),
+                      repulsion=spec.repulsion,
+                      name=f"fleet-serve-{spec.name}")
+    sp = obtrace.begin("fleet.serve", cat="fleet", job=spec.name)
+    record = {"name": spec.name, "status": "ok"}
+    wd = Watchdog(spec.job_timeout, spec.stage_timeout,
+                  label=f"serve-{spec.name}")
+    try:
+        model = load_frozen(spec.model, x, plan,
+                            perplexity=float(spec.perplexity),
+                            learning_rate=float(spec.learning_rate),
+                            metric=spec.metric)
+        daemon = ServeDaemon(model, spec.spool, bucket=spec.bucket,
+                             iters=spec.iters, eta=spec.eta, watchdog=wd)
+        record.update(daemon.serve_forever(max_ticks=spec.max_ticks))
+    except BaseException as e:
+        record.update(status="error", error=f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        sp.end()
+        record["seconds"] = round(sp.seconds, 3)
+        faults.activate(None)
+        if spec.record:
+            try:
+                from tsne_flink_tpu.utils.io import atomic_write
+
+                def write(tmp):
+                    with open(tmp, "w") as f:
+                        json.dump(record, f, indent=2)
+                atomic_write(spec.record, write)
+            except OSError:
+                pass  # record is evidence, not a correctness dependency
+    return record
+
+
 def main(argv=None) -> int:
     """Subprocess entry: ``python -m tsne_flink_tpu.runtime.fleet --job
-    spec.json`` — the isolation boundary every fleet job runs behind."""
+    spec.json`` (one embed job) or ``--serve spec.json`` (the graftserve
+    daemon) — the isolation boundary fleet processes run behind."""
     import argparse
     p = argparse.ArgumentParser(prog="tsne-fleet-job")
-    p.add_argument("--job", required=True, help="JobSpec JSON path")
+    p.add_argument("--job", help="JobSpec JSON path")
+    p.add_argument("--serve", help="ServeSpec JSON path (daemon mode)")
     args = p.parse_args(argv)
+    if bool(args.job) == bool(args.serve):
+        p.error("exactly one of --job / --serve is required")
+    if args.serve:
+        run_serve(ServeSpec.load(args.serve))
+        return 0
     run_job(JobSpec.load(args.job))
     return 0
 

@@ -1,0 +1,23 @@
+"""graftserve — out-of-sample ``transform()`` and the long-lived embed daemon.
+
+The batch pipeline ends where the reference ends: one embedding, written
+once (``Tsne.scala:86``).  Serving inverts the shape of the work — a
+frozen map answers thousands of small "where does THIS point land?"
+queries — and this package is that path:
+
+* :mod:`serve.model` — :class:`~tsne_flink_tpu.serve.model.FrozenModel`:
+  the fat v2 checkpoint + base features loaded ONCE into device-resident
+  arrays, read-only by contract, with the FFT base field precomputed at
+  load when the plan serves fft repulsion;
+* :mod:`serve.transform` — the query path (kNN → directed affinities →
+  interpolation init → fixed-iteration query-row optimize) as jitted,
+  AOT-persisted stage functions over fixed micro-bucket shapes;
+* :mod:`serve.daemon` — the warm spool-directory daemon: model + AOT
+  executables resident, per-request latency records, graftfleet
+  watchdog/lock/fault conventions.
+"""
+
+from tsne_flink_tpu.serve.model import FrozenModel, load_frozen
+from tsne_flink_tpu.serve.transform import transform
+
+__all__ = ["FrozenModel", "load_frozen", "transform"]

@@ -1,0 +1,307 @@
+"""The long-lived embed daemon: a warm FrozenModel behind a spool directory.
+
+Graftfleet's file conventions, inverted for serving.  A fleet job is one
+process per embedding; the daemon is ONE process answering many small
+requests, with everything expensive — the model arrays, the FFT base
+field, the three compiled stage executables — resident from the first
+request to the last:
+
+* **requests** are ``<id>.req.npz`` files (one float array ``x``,
+  ``[B, d]``) dropped into the spool directory.  :func:`submit` writes
+  them atomically (tmp + rename, like every output writer in this repo),
+  so the daemon never observes a torn request.
+* **claims** are ``utils/locks.FileLock`` on ``<id>.req.npz.lock`` — the
+  same O_EXCL + stale-break protocol as the cache writers, so a daemon
+  SIGKILLed mid-request leaves a lock that the restarted daemon breaks
+  after ``TSNE_LOCK_STALE_S`` and re-serves bit-identically (the
+  transform has no RNG and the AOT cache is warm — pinned by the chaos
+  test in ``tests/test_serve.py``).
+* **results** are ``<id>.res.npz`` (array ``y``) + ``<id>.lat.json``
+  (the per-request latency record: rows, buckets, seconds, model_id),
+  both atomic; the request file is deleted only AFTER the result lands,
+  so ``.res`` presence is the done marker and a crash between compute
+  and write just re-serves.
+* **micro-batching**: each tick coalesces claimed requests up to
+  ``TSNE_SERVE_MAX_BATCH`` rows and runs ONE transform over the
+  concatenation — per-row independence (serve/transform.py) makes the
+  split-back bit-identical to per-request serving, and the fixed bucket
+  shapes mean a warm daemon never recompiles.
+
+PR-8 conventions ride along: the fleet :class:`~tsne_flink_tpu.runtime.
+fleet.Watchdog` beats every tick (a hung device stalls the beat and the
+watchdog kills the process — exit 124 — rather than silently wedging the
+spool), and the ``serve`` fault site fires at tick start (oom / delay /
+nan rehearsal) and at the post-compute request boundary (kill@serve —
+the crash window the chaos test aims at).  Startup admission-checks the
+model + bucket against the graftcheck HBM budget
+(:meth:`FrozenModel.admission_report`) before going warm — the same
+"predict, then commit" contract the fleet scheduler enforces per job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from tsne_flink_tpu.obs import trace as obtrace
+from tsne_flink_tpu.obs.trace import walltime
+from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.utils.env import env_float, env_int, env_str
+from tsne_flink_tpu.utils.io import atomic_write
+from tsne_flink_tpu.utils.locks import FileLock
+
+REQ_SUFFIX = ".req.npz"
+RES_SUFFIX = ".res.npz"
+LAT_SUFFIX = ".lat.json"
+
+
+def pick_spool(spool: str | None = None) -> str:
+    """The spool directory: the explicit argument, else
+    ``TSNE_SERVE_SPOOL``.  Recorded on every serve record as ``spool``."""
+    got = spool or env_str("TSNE_SERVE_SPOOL")
+    if not got:
+        raise ValueError("no spool directory: pass spool= or set "
+                         "TSNE_SERVE_SPOOL")
+    return str(got)
+
+
+def submit(spool: str, x, req_id: str) -> str:
+    """Drop one request into the spool (atomic) and return its path."""
+    xq = np.ascontiguousarray(np.asarray(x))
+    if xq.ndim != 2:
+        raise ValueError(f"request must be [B, d], got {xq.shape}")
+    path = os.path.join(spool, req_id + REQ_SUFFIX)
+
+    def write(tmp):
+        with open(tmp, "wb") as f:
+            np.savez(f, x=xq)
+    atomic_write(path, write)
+    return path
+
+
+def read_result(spool: str, req_id: str):
+    """The served embedding for ``req_id``, or None while pending."""
+    path = os.path.join(spool, req_id + RES_SUFFIX)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        return z["y"]
+
+
+def _req_id(req_path: str) -> str:
+    return os.path.basename(req_path)[:-len(REQ_SUFFIX)]
+
+
+class ServeDaemon:
+    """The warm process: model resident, executables compiled, spool
+    polled every ``tick_s`` until stopped (or idle past
+    ``TSNE_SERVE_IDLE_EXIT_S``)."""
+
+    def __init__(self, model, spool: str | None = None, *,
+                 bucket: int | None = None, iters: int | None = None,
+                 eta: float | None = None,
+                 tick_s: float | None = None, max_batch: int | None = None,
+                 idle_exit_s: float | None = None, watchdog=None,
+                 budget_bytes=None):
+        from tsne_flink_tpu.serve.transform import (pick_serve_bucket,
+                                                    pick_transform_eta,
+                                                    pick_transform_iters)
+        self.model = model
+        self.spool = pick_spool(spool)
+        self.bucket = pick_serve_bucket(bucket)
+        self.iters = pick_transform_iters(iters)
+        self.eta = pick_transform_eta(eta)
+        self.tick_s = (float(tick_s) if tick_s is not None
+                       else float(env_float("TSNE_SERVE_TICK_S")))
+        self.max_batch = (int(max_batch) if max_batch
+                          else int(env_int("TSNE_SERVE_MAX_BATCH")))
+        idle = (float(idle_exit_s) if idle_exit_s is not None
+                else env_float("TSNE_SERVE_IDLE_EXIT_S"))
+        self.idle_exit_s = idle if idle else None  # unset/0 = run forever
+        self.watchdog = watchdog
+        self.latencies_s: list[float] = []
+        self.served = 0
+        self.admission = self._admit(budget_bytes)
+
+    # ---- admission ---------------------------------------------------------
+
+    def _admit(self, budget_bytes) -> dict:
+        """Predict-then-commit: the graftcheck HBM report of this model
+        serving ``bucket``-row buckets must fit the backend budget.  Over
+        budget raises BEFORE any compile — the daemon refuses to go warm
+        on a footing the audit says will OOM."""
+        import jax
+
+        from tsne_flink_tpu.analysis.audit.hbm import transform_peak_bytes
+        from tsne_flink_tpu.runtime.admission import default_budget
+        budget = (int(budget_bytes) if budget_bytes
+                  else default_budget(jax.default_backend()))
+        peak = transform_peak_bytes(self.model.serve_plan(self.bucket))
+        if budget is not None and peak > budget:
+            raise RuntimeError(
+                f"serve admission: predicted peak {peak} bytes exceeds "
+                f"budget {budget} for bucket={self.bucket} "
+                f"(model n={self.model.n}); shrink TSNE_SERVE_BUCKET")
+        return {"peak_bytes": peak, "budget_bytes": budget}
+
+    # ---- request plumbing --------------------------------------------------
+
+    def _pending(self) -> list[str]:
+        try:
+            names = os.listdir(self.spool)
+        except OSError:
+            return []
+        return sorted(os.path.join(self.spool, n) for n in names
+                      if n.endswith(REQ_SUFFIX))
+
+    def _claim(self, req_path: str):
+        """The request's rows if we hold its lock and it is unserved,
+        else None.  A torn/unreadable file stays claimed-by-nobody until
+        its writer finishes the rename (writes are atomic, so this only
+        means 'not ours this tick')."""
+        if os.path.exists(os.path.join(
+                self.spool, _req_id(req_path) + RES_SUFFIX)):
+            # served before a crash could delete the request: finish the
+            # delete and move on (the result is the done marker)
+            try:
+                os.remove(req_path)
+            except OSError:
+                pass
+            return None
+        lock = FileLock(req_path + ".lock")
+        if not lock.acquire(timeout_s=0.0):
+            return None
+        try:
+            with np.load(req_path) as z:
+                return lock, np.asarray(z["x"])
+        except (OSError, KeyError, ValueError):
+            lock.release()
+            return None
+
+    def _finish(self, req_path: str, lock: FileLock, y: np.ndarray,
+                seconds: float) -> None:
+        rid = _req_id(req_path)
+        res = os.path.join(self.spool, rid + RES_SUFFIX)
+
+        def write_res(tmp):
+            with open(tmp, "wb") as f:
+                np.savez(f, y=y)
+        atomic_write(res, write_res)
+
+        def write_lat(tmp):
+            with open(tmp, "w") as f:
+                json.dump({"req": rid, "rows": int(y.shape[0]),
+                           "seconds": round(float(seconds), 6),
+                           "bucket": self.bucket, "iters": self.iters,
+                           "eta": self.eta,
+                           "model_id": self.model.model_id}, f)
+        atomic_write(os.path.join(self.spool, rid + LAT_SUFFIX), write_lat)
+        try:
+            os.remove(req_path)
+        except OSError:
+            pass
+        lock.release()
+        self.latencies_s.append(float(seconds))
+        self.served += 1
+
+    # ---- the tick ----------------------------------------------------------
+
+    def drain_once(self) -> int:
+        """One tick: claim pending requests up to ``max_batch`` rows,
+        serve them through ONE coalesced transform, write results.
+        Returns the number of requests completed."""
+        from tsne_flink_tpu.serve.transform import transform
+
+        inj = faults.injector()
+        if inj:
+            inj.fire("serve")  # oom / delay / nan rehearsal at tick start
+        claimed: list[tuple[str, FileLock, np.ndarray]] = []
+        rows = 0
+        for req_path in self._pending():
+            if rows >= self.max_batch:
+                break
+            got = self._claim(req_path)
+            if got is None:
+                continue
+            lock, x = got
+            claimed.append((req_path, lock, x))
+            rows += int(x.shape[0])
+        if not claimed:
+            return 0
+        done = 0
+        try:
+            with obtrace.span("serve.drain", cat="serve", requests=len(
+                    claimed), rows=rows) as sp:
+                xs = np.concatenate([x for _, _, x in claimed], axis=0)
+                y = transform(self.model, xs, bucket=self.bucket,
+                              iters=self.iters, eta=self.eta)
+            per_req = sp.seconds / len(claimed)
+            off = 0
+            for req_path, lock, x in claimed:
+                b = int(x.shape[0])
+                if inj:
+                    # kill@serve lands HERE: after compute, before this
+                    # request's result write — the restarted daemon finds
+                    # the request file intact and re-serves bit-identically
+                    inj.fire("serve", seg=self.served, point="boundary")
+                self._finish(req_path, lock, y[off:off + b], per_req)
+                off += b
+                done += 1
+            claimed = []
+        finally:
+            for _, lock, _ in claimed:
+                lock.release()  # crash path: unserved claims unlock now
+        return done
+
+    def serve_forever(self, max_ticks: int | None = None) -> dict:
+        """Poll the spool until ``max_ticks`` (tests) or idle-exit.  The
+        watchdog (when armed) beats once per tick — a wedged transform
+        stops the beat and the watchdog takes the process down."""
+        if self.watchdog is not None:
+            self.watchdog.start()
+        last_work = walltime()
+        ticks = 0
+        try:
+            while max_ticks is None or ticks < max_ticks:
+                ticks += 1
+                n = self.drain_once()
+                if self.watchdog is not None:
+                    self.watchdog.beat("serve")
+                now = walltime()
+                if n:
+                    last_work = now
+                elif (self.idle_exit_s is not None
+                      and now - last_work > float(self.idle_exit_s)):
+                    break
+                if n == 0:
+                    time.sleep(self.tick_s)
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
+        return self.summary()
+
+    # ---- evidence ----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The serving summary: request count + latency percentiles, the
+        shape the serve bench record pins."""
+        lat = sorted(self.latencies_s)
+        return {"served": self.served,
+                "p50_ms": round(_pct(lat, 0.50) * 1e3, 3),
+                "p99_ms": round(_pct(lat, 0.99) * 1e3, 3),
+                "bucket": self.bucket, "iters": self.iters,
+                "eta": self.eta,
+                "model_id": self.model.model_id,
+                "admission": self.admission}
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list (0.0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(round(
+        q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[i])

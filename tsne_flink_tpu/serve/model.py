@@ -1,0 +1,153 @@
+"""FrozenModel — a fitted embedding as a device-resident, read-only model.
+
+One load pays everything the query path will ever need from the base set:
+
+* the base features ``x`` (kNN + beta search run against them),
+* the base embedding ``y`` (interpolation init + attraction/repulsion
+  targets),
+* the training plan record (AOT key identity + admission math), and
+* for fft-serving plans, the precomputed repulsion field of the frozen
+  base (:func:`tsne_flink_tpu.ops.repulsion_fft.fft_base_field`) — the
+  spread + convolve side of FIt-SNE done ONCE, leaving only the per-query
+  Lagrange gather at serve time.
+
+Read-only contract: :func:`load_frozen` goes through
+``utils/checkpoint.load_model`` — a strict verified ``np.load`` with no
+rotation, no tmp files, no fault hook — so opening a checkpoint as a
+model leaves its directory byte-identical (pinned by
+``tests/test_serve.py``).  v1 / hash-less files are refused: a daemon
+answers queries from this state for hours and must know exactly what it
+loaded.  The verified content hash is folded into :attr:`FrozenModel.
+model_id` together with a fingerprint of the base features, so every
+serve record names the exact (map, data) pair it was produced from.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+from tsne_flink_tpu.obs import trace as obtrace
+
+
+def _fingerprint(*arrays) -> str:
+    """sha256 over (dtype, shape, bytes) of each array, in order."""
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(repr((a.dtype.str, a.shape)).encode())
+        h.update(a.view(np.uint8).reshape(-1).data)
+    return h.hexdigest()
+
+
+def serve_repulsion(plan: PlanConfig) -> str:
+    """The repulsion kernel the QUERY path runs for this plan: the plan's
+    resolved backend, with ``bh`` demoted to ``exact`` — the tree is
+    rebuilt from scratch per iteration in the batch path, so against a
+    frozen base it amortizes nothing over the exact [B, N] sweep at
+    serving bucket sizes, while ``exact`` and ``fft`` (whose base field
+    precomputes entirely) keep their batch-path cost shapes.  Rides every
+    serve record as ``repulsion``."""
+    rep = plan.resolved_repulsion()
+    return "fft" if rep == "fft" else "exact"
+
+
+@dataclass(frozen=True)
+class FrozenModel:
+    """The loaded model: device-resident arrays + identity + plan.
+
+    Frozen dataclass on purpose — nothing in the serving path may write
+    to it; the transform stages take its arrays as ARGUMENTS (so the
+    jitted executables are model-shape-keyed, not model-value-baked)."""
+
+    x: object            # [N, d] base features (device array)
+    y: object            # [N, m] base embedding (device array)
+    plan: PlanConfig
+    perplexity: float
+    learning_rate: float
+    metric: str
+    repulsion: str       # exact | fft (serve_repulsion)
+    model_id: str
+    ckpt_hash: str | None = None
+    field: object = None  # ops/repulsion_fft.FftField for fft serving
+
+    @property
+    def n(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def k(self) -> int:
+        return int(min(self.plan.k, self.n))
+
+    def serve_plan(self, bucket: int) -> PlanConfig:
+        """This model's plan as a SERVING plan: ``serve_queries`` set to
+        the micro-bucket width (which switches the transform stage on in
+        the HBM audit)."""
+        return replace(self.plan, serve_queries=int(bucket),
+                       name=f"serve-{self.plan.name}")
+
+    def admission_report(self, bucket: int) -> dict:
+        """The graftcheck HBM report of THIS model serving ``bucket``-row
+        micro-buckets — the frozen model counted as resident (the
+        ``transform`` stage of analysis/audit/hbm.py).  The daemon
+        admission-checks against it before going warm."""
+        from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
+        return plan_hbm_report(self.serve_plan(bucket))
+
+
+def from_arrays(x, y, plan: PlanConfig, *, perplexity: float = 30.0,
+                learning_rate: float = 1000.0, metric: str = "sqeuclidean",
+                ckpt_hash: str | None = None) -> FrozenModel:
+    """Build a FrozenModel straight from arrays (the estimator path —
+    ``TSNE.transform`` freezes its own fit without a checkpoint round
+    trip).  ``model_id`` = sha256 over the checkpoint content hash when
+    one exists (checkpoint identity already covers the embedding), else
+    over the embedding bytes, plus the base-feature fingerprint."""
+    import jax.numpy as jnp
+
+    with obtrace.span("serve.model_load", cat="serve") as sp:
+        xd = jnp.asarray(x)
+        yd = jnp.asarray(y, dtype=xd.dtype)
+        if xd.shape[0] != yd.shape[0]:
+            raise ValueError(
+                f"base features and embedding disagree on N: "
+                f"{xd.shape[0]} vs {yd.shape[0]}")
+        rep = serve_repulsion(plan)
+        emb_id = ckpt_hash if ckpt_hash else _fingerprint(y)
+        model_id = hashlib.sha256(
+            f"{emb_id}|{_fingerprint(x)}|{rep}".encode()).hexdigest()[:16]
+        field = None
+        if rep == "fft":
+            from tsne_flink_tpu.ops.repulsion_fft import fft_base_field
+            field = fft_base_field(yd)
+        sp.set(n=int(xd.shape[0]), model_id=model_id, repulsion=rep)
+    return FrozenModel(x=xd, y=yd, plan=plan, perplexity=float(perplexity),
+                       learning_rate=float(learning_rate), metric=metric,
+                       repulsion=rep, model_id=model_id,
+                       ckpt_hash=ckpt_hash, field=field)
+
+
+def load_frozen(ckpt_path: str, x, plan: PlanConfig, *,
+                perplexity: float = 30.0, learning_rate: float = 1000.0,
+                metric: str = "sqeuclidean") -> FrozenModel:
+    """Load a fat v2 checkpoint as a FrozenModel: strict verified
+    read-only open (module docstring), base features supplied by the
+    caller (checkpoints deliberately do not carry the input — the CLI's
+    ``--model`` pairs with ``--input``/``--generate`` exactly like a
+    fit)."""
+    from tsne_flink_tpu.utils import checkpoint as ckpt
+
+    state, _next_iter, _losses, _prepare, content_hash = (
+        ckpt.load_model(ckpt_path))
+    x_arr = np.asarray(x)
+    if state.y.shape[0] != x_arr.shape[0]:
+        raise ValueError(
+            f"checkpoint {ckpt_path} embeds {state.y.shape[0]} points but "
+            f"the supplied base features carry {x_arr.shape[0]} rows — "
+            "the --model/--input pair must describe the same dataset")
+    return from_arrays(x_arr, state.y, plan, perplexity=perplexity,
+                       learning_rate=learning_rate, metric=metric,
+                       ckpt_hash=content_hash)

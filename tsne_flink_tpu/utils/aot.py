@@ -139,6 +139,43 @@ def default_root() -> str:
 
 # ---- keys ------------------------------------------------------------------
 
+_SOURCE_FP_CACHE: list = []
+
+
+def source_fingerprint() -> str:
+    """sha256 over every ``.py`` file of the package (path + contents,
+    sorted) — folded into :func:`entry_key` so an on-disk source edit is a
+    clean AOT miss instead of a stale executable silently serving old code
+    (the PR-12 hazard: plan/backend/jax-version alone cannot see a kernel
+    rewrite).  Cached per process; tests reset via
+    :func:`reset_source_fingerprint`."""
+    if _SOURCE_FP_CACHE:
+        return _SOURCE_FP_CACHE[0]
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    digest = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(pkg_root)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            digest.update(os.path.relpath(path, pkg_root).encode())
+            try:
+                with open(path, "rb") as f:
+                    digest.update(f.read())
+            except OSError:
+                continue  # racing editor save: fingerprint what's readable
+    fp = digest.hexdigest()[:16]
+    _SOURCE_FP_CACHE.append(fp)
+    return fp
+
+
+def reset_source_fingerprint() -> None:
+    """Drop the per-process source-fingerprint cache (tests that edit a
+    package file on disk call this to observe the key change)."""
+    _SOURCE_FP_CACHE.clear()
+
+
 def plan_key_parts(plan) -> dict:
     """The graftcheck ``PlanConfig`` as AOT key parts: its full JSON dict,
     so any plan field change (shape, backend, dtype, stage choice, tile-
@@ -178,6 +215,7 @@ def entry_key(key_parts: dict, args=(), kwargs=None, label: str = "") -> str:
         "_backend": jax.default_backend(),
         "_host": host_signature(),
         "_matmul_dtype": str(matmul_dtype()),
+        "_source": source_fingerprint(),
     })
     blob = repr(sorted((str(k), repr(v)) for k, v in parts.items()))
     return hashlib.sha256(blob.encode()).hexdigest()[:32]

@@ -214,6 +214,49 @@ def load_pilot(path: str):
         return z["pilot_state"], z["pilot_trace"]
 
 
+def load_model(path: str):
+    """Strict frozen-model read (graftserve): one verified open returning
+    ``(state, next_iter, losses, prepare, content_hash)``.
+
+    Serving has a tighter contract than ``--resume``:
+
+    * **read-only** — this function only ever ``np.load``-s the file; no
+      rotation, no tmp files, no fault hook: the checkpoint directory is
+      byte-identical after a model load (pinned by test);
+    * **v2 + hash required** — a v1 file or a hash-less file is refused
+      with :class:`NotACheckpoint` rather than served unverified, because
+      a daemon answers queries from this state for hours and must know
+      exactly what it loaded (the ``content_hash`` doubles as the
+      model-identity component of ``serve.model.FrozenModel.model_id``).
+    """
+    with _open_verified(path) as z:
+        if str(z["magic"]) != MAGIC:
+            raise NotACheckpoint(
+                f"{path} is not a v2 checkpoint — serving requires the "
+                "content-verified fat format (re-save with the current "
+                "writer)")
+        if "content_hash" not in z.files:
+            raise NotACheckpoint(
+                f"{path} carries no content hash — refusing to serve an "
+                "unverifiable model")
+        try:
+            state = TsneState(y=np.asarray(z["y"]),
+                              update=np.asarray(z["update"]),
+                              gains=np.asarray(z["gains"]))
+            next_iter = int(z["next_iter"])
+            losses = np.asarray(z["losses"])
+            prepare = {}
+            for k in PREPARE_KEYS:
+                name = "prep_" + k
+                if name in z.files:
+                    v = z[name]
+                    prepare[k] = str(v) if v.dtype.kind == "U" else np.asarray(v)
+            return (state, next_iter, losses, prepare or None,
+                    str(z["content_hash"]))
+        except (ValueError, KeyError) as e:
+            raise CheckpointCorrupt(path, detail=str(e)) from e
+
+
 def load_prepare(path: str) -> dict | None:
     """The v2 prepare payload of ``path`` as a dict (strings for
     ``affinity_fp``/``label``/``audit``/``events``, numpy arrays

@@ -170,6 +170,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="embed the assembled P arrays in every checkpoint "
                         "(larger files) so --resume skips the whole prepare "
                         "stage even without the artifact cache")
+    p.add_argument("--model", default=None,
+                   help="graftserve: a fat v2 checkpoint to open READ-ONLY "
+                        "as a frozen map (serve/model.py); pairs with "
+                        "--input supplying the base features the map was "
+                        "fit on, and with --transform supplying the rows "
+                        "to embed")
+    p.add_argument("--transform", default=None,
+                   help="graftserve: embed THESE rows (same text format as "
+                        "--input) into the frozen --model map instead of "
+                        "fitting — out-of-sample transform; coordinates "
+                        "land in --output")
     p.add_argument("--aotCache", dest="aotCache", action="store_true",
                    default=None,
                    help="force the plan-keyed AOT executable cache "
@@ -836,6 +847,34 @@ def _main(argv=None, sp_run=None) -> int:
         # graftpilot: flag or env arms the KL-guarded controller
         autopilot=bool(args.autopilot) or env_bool("TSNE_AUTOPILOT"),
     )
+
+    # ---- graftserve: --transform/--model is the SERVE route — open the
+    # frozen map read-only, embed the query rows, write, exit.  No fit,
+    # no checkpoint rotation, no prepare stage.
+    if args.transform or args.model:
+        if not (args.transform and args.model):
+            parser.error("--transform and --model go together: --model is "
+                         "the frozen map (fat v2 checkpoint), --transform "
+                         "the query rows to embed into it")
+        if args.inputDistanceMatrix:
+            parser.error("--transform needs raw base features via --input "
+                         "(a distance matrix carries no coordinates to "
+                         "run query kNN against)")
+        from tsne_flink_tpu.serve.model import load_frozen
+        from tsne_flink_tpu.serve.transform import transform as _serve
+        model = load_frozen(args.model, x_np,
+                            _run_plan(args, cfg, n, assembly, neighbors),
+                            perplexity=args.perplexity,
+                            learning_rate=args.learningRate,
+                            metric=args.metric)
+        qids, q_np = tio.read_input(args.transform, args.dimension)
+        yq = _serve(model, q_np)
+        tio.write_embedding(args.output, np.asarray(qids), yq)
+        print(f"transformed {len(qids)} rows into frozen map "
+              f"{model.model_id} -> {args.output}")
+        sp_run.end()
+        _write_obs_outputs(trace_path, metrics_path)
+        return 0
 
     # static plan audit BEFORE any expensive stage: the whole point is
     # refusing a predicted OOM in seconds instead of at hour 4 on-chip

@@ -207,6 +207,51 @@ _declare("TSNE_LOCK_STALE_S", "float", 60.0,
          "(utils/locks.py) is considered abandoned (writer died mid-hold) "
          "and is broken by the next acquirer.")
 
+# ---- graftserve (tsne_flink_tpu/serve/) ------------------------------------
+_declare("TSNE_SERVE_BUCKET", "int", 256,
+         "Micro-bucket width of the serving transform (serve/transform.py): "
+         "every query batch is chopped into fixed BUCKET-row padded "
+         "buckets, each run through the SAME jitted/AOT executables — so "
+         "recompiles stay zero for arbitrary request sizes and the result "
+         "is bit-identical across external batch splits (256 == 4 x 64, "
+         "pinned by test). Rides every serve record as 'bucket'.")
+_declare("TSNE_TRANSFORM_ITERS", "int", 75,
+         "Fixed query-row optimize iterations of the out-of-sample "
+         "transform (serve/transform.py) — the openTSNE-recipe refinement "
+         "after affinity-weighted interpolation init. Fixed (not "
+         "convergence-gated) so every query pays the same latency and the "
+         "executables are shape/iteration-static. Rides serve records as "
+         "'iters'.")
+_declare("TSNE_TRANSFORM_ETA", "float", None,
+         "Query-row step size of the out-of-sample transform "
+         "(serve/transform.py). Deliberately N-INDEPENDENT, unlike the "
+         "fit's learning rate: the query path optimizes the per-row "
+         "conditional KL whose gradient is O(1) embedding units at any "
+         "N, and must close the interpolation-init gap in a fixed "
+         "iteration budget. Unset = the serve policy default (0.5, "
+         "calibrated on the 60k self-transform sweep). Rides serve "
+         "records as 'eta'.")
+_declare("TSNE_SERVE_SPOOL", "path", None,
+         "Spool directory the embed daemon (serve/daemon.py) watches for "
+         "*.req.npz request files (graftfleet file conventions: atomic "
+         "claim via utils/locks.py, result + latency record written "
+         "next to the request). ServeSpec.spool / ServeDaemon(spool=) "
+         "overrides per daemon.")
+_declare("TSNE_SERVE_TICK_S", "float", 0.05,
+         "Seconds the embed daemon sleeps between spool scans when no "
+         "request is waiting (a waiting request is drained immediately; "
+         "requests arriving within one tick coalesce into one "
+         "micro-batched transform call).")
+_declare("TSNE_SERVE_MAX_BATCH", "int", 1024,
+         "Most query rows the embed daemon coalesces into one transform "
+         "call per tick; further spooled requests wait for the next tick "
+         "(bounds per-tick HBM alongside the graftcheck admission "
+         "estimate).")
+_declare("TSNE_SERVE_IDLE_EXIT_S", "float", None,
+         "Seconds of empty-spool idling after which the embed daemon "
+         "exits cleanly (tests and batch drains); unset/0 = run forever "
+         "(production daemon mode, killed by signal).")
+
 # ---- caches ----------------------------------------------------------------
 _declare("TSNE_ARTIFACTS", "bool", True,
          "Prepare-artifact cache (utils/artifacts.py) on/off for bench/CLI "
