@@ -81,7 +81,7 @@ RECORD_BASE_KEYS = (
     "knn_tiles", "audit", "degradations", "aot_cache", "memory",
     "host_calib", "fleet", "mesh", "kl", "repulsion_stride",
     "effective_seconds_per_iter", "repulsion_refreshes", "policy",
-    "serve",
+    "serve", "step_split",
 )
 
 
@@ -244,6 +244,61 @@ def _att_kernel_label():
     """The resolved fused-attraction kernel for this process (graftstep)."""
     from tsne_flink_tpu.ops.attraction_pallas import pick_attraction_kernel
     return pick_attraction_kernel()
+
+
+def _step_split_probe(cfg, state, jidx, jval, extra_edges, reps):
+    """graftfloor satellite: the optimize iteration's per-term cost —
+    ``attraction`` / ``repulsion`` / ``integration`` seconds per
+    iteration — measured POST-RUN as amortized jitted probes on the run's
+    real arrays.  The in-loop program stays untouched (sync-free: no
+    per-term device syncs ever enter the fori_loop); each term is the
+    mean of ``reps`` synced calls under its own obs span
+    (``bench.step_split.<term>``), so the 0.30 s/iter attraction floor
+    is a measured record field instead of an A/B inference."""
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import (_attraction_forces, _center,
+                                            _plan_layout, _repulsion,
+                                            _repulsion_scratch,
+                                            _update_embedding)
+    from tsne_flink_tpu.obs import trace as obtrace
+
+    y = state.y
+    dtype = y.dtype
+    exag = jnp.ones((), dtype)
+    if extra_edges is not None:
+        edges, csr, edges_extra = extra_edges, None, True
+    else:
+        edges, csr = _plan_layout(jidx, jval, cfg)
+        edges_extra = False
+    scratch = _repulsion_scratch(cfg, int(y.shape[1]), dtype)
+    mom = jnp.asarray(cfg.final_momentum, dtype)
+
+    # graftlint: disable=jit-hygiene -- post-run measurement probes on a
+    # finished state: nothing re-binds, nothing is donated, each runs a
+    # handful of times
+    att = jax.jit(lambda yy: _attraction_forces(
+        yy, yy, jidx, jval, cfg, exag, edges=edges,
+        edges_extra=edges_extra, csr=csr))
+    rep = jax.jit(lambda yy: _repulsion(yy, yy, cfg, None, 0, None,
+                                        scratch))
+    integ = jax.jit(lambda st, g: _center(_update_embedding(st, g, mom,
+                                                            cfg)))
+    grad = jax.block_until_ready(att(y))
+    probes = {"attraction": lambda: att(y),
+              "repulsion": lambda: rep(y),
+              "integration": lambda: integ(state, grad)}
+    out = {}
+    for name, fn in probes.items():
+        jax.block_until_ready(fn())  # compile + warm outside the timing
+        sp = obtrace.begin(f"bench.step_split.{name}", cat="optimize")
+        for _ in range(reps):
+            jax.block_until_ready(fn())
+        out[name] = round(sp.end().seconds / reps, 6)
+    out["reps"] = reps
+    out["basis"] = "post-run amortized jitted probes on the run state"
+    return out
 
 
 class _DeadlineStop(Exception):
@@ -439,7 +494,7 @@ def main():
                        theta=theta, assembly=assembly,
                        attraction=attraction, row_chunk=cfg.row_chunk,
                        mesh=mesh_count, autopilot=autopilot_on,
-                       name="bench")
+                       fft_grid=cfg.fft_grid, name="bench")
     _hbm = plan_hbm_report(_plan)
     audit_rec = {"peak_hbm_est": _hbm["peak_hbm_est"],
                  "peak_stage": _hbm["peak_stage"],
@@ -556,6 +611,11 @@ def main():
         # serve sweep ran against this fit's frozen map, None for a pure
         # batch bench (this script never serves)
         "serve": None,
+        # graftfloor satellite: per-term optimize cost split
+        # ({attraction, repulsion, integration} s/iter — the post-run
+        # amortized probe, _step_split_probe), None until the optimize
+        # stage completes on the full-shape state
+        "step_split": None,
     }
     if env_bool("TSNE_TUNNEL_DOWN"):
         # VERDICT r5 item 9: the TPU backend was probed first and did not
@@ -676,6 +736,65 @@ def main():
                            nnz_pairs=pairs if use_edges else None,
                            theta=cfg.theta,  # bh auto-frontier mirror
                            mpad=8 if backend == "tpu" else 3)
+
+    # graftfloor: the landmark coarse-to-fine schedule (models/autopilot
+    # pick_landmark — auto engages with the autopilot at this N; row
+    # layouts only, the blocks layout has no row restriction).  The
+    # decision + fractions land on the record's policy block, and the
+    # FLOP model becomes the two-phase sum so MFU counts the work that
+    # actually runs.
+    land_info = None
+    land: dict = {}
+    if pilot_mod.pick_landmark(cfg, n) and label != "blocks":
+        from dataclasses import replace as _cfg_replace
+
+        from tsne_flink_tpu.ops.affinities import subsample_affinities
+        land_iters, polish = pilot_mod.landmark_schedule(cfg)
+        lm = pilot_mod.landmark_points(n, DATA_SEED)
+        n_land = int(lm.shape[0])
+        if land_iters >= LOSS_EVERY and polish > 0 and 8 <= n_land < n:
+            sub_idx, sub_val = subsample_affinities(jidx, jval, lm)
+            # coarse-to-fine in grid too: the landmark descent runs at
+            # half FFT resolution (models/autopilot.landmark_grid) —
+            # the full-grid FFT dominates the subsample iteration
+            cfg_land = _cfg_replace(
+                cfg, iterations=land_iters,
+                fft_grid=pilot_mod.landmark_grid(cfg, 2))
+            _plan_land = _plan_replace(_plan, n=n_land,
+                                       iterations=land_iters,
+                                       sym_width=int(sub_idx.shape[1]),
+                                       fft_grid=cfg_land.fft_grid,
+                                       name="bench-landmark")
+            runner_land = ShardedOptimizer(cfg_land, n_land,
+                                           n_devices=mesh_devices,
+                                           aot_plan=_plan_land)
+            layout_l, pairs_l, _ = runner_land.attraction_plan(sub_idx,
+                                                               sub_val)
+            s_land = int(sub_idx.shape[1])
+            f_opt = (optimize_flops(
+                n_land, s_land, 2, land_iters, repulsion,
+                nnz_pairs=pairs_l if layout_l in ("edges", "csr")
+                else None, theta=cfg.theta,
+                mpad=8 if backend == "tpu" else 3)
+                + optimize_flops(
+                    n, s, 2, polish, repulsion,
+                    nnz_pairs=pairs if use_edges else None,
+                    theta=cfg.theta, mpad=8 if backend == "tpu" else 3))
+            land.update(lm=lm, sub_idx=sub_idx, sub_val=sub_val,
+                        cfg_land=cfg_land, runner_land=runner_land,
+                        land_iters=land_iters, polish=polish,
+                        plan=_plan_land)
+            land_info = {"landmark": True,
+                         "landmark_fraction":
+                             pilot_mod.landmark_fraction(),
+                         "n_landmark": n_land,
+                         "landmark_iters": land_iters,
+                         "polish_iters": polish,
+                         "landmark_grid": cfg_land.fft_grid}
+            print(f"# landmark schedule: {n_land}/{n} landmarks for "
+                  f"{land_iters} iters, joint polish {polish} iters",
+                  file=sys.stderr)
+
     rate = (f_knn_run + f_aff_run) / max(t_knn + t_aff, 1e-9)
     emit_partial(t_knn + t_aff,
                  t_knn + t_aff + (f_opt / rate if rate > 0 else 0.0),
@@ -711,10 +830,11 @@ def main():
         """Refresh the graftpilot satellite keys on ``base`` so EVERY
         superseding emission carries the measured per-iter rate, the
         actual refresh count and the live decision record; each NEW
-        stride/grid transition also lands as an obs instant."""
+        stride/grid transition also lands as an obs instant.  graftfloor:
+        the landmark decision (``land_info``) rides the same block."""
         pol = pilot_mod.policy_report(
             cfg, sup.last_pilot if autopilot_on else None,
-            iterations_run=it_done)
+            iterations_run=it_done, landmark=land_info)
         base["policy"] = pol
         base["repulsion_refreshes"] = pol["repulsion_refreshes"]
         base["effective_seconds_per_iter"] = (
@@ -749,16 +869,63 @@ def main():
         if _remaining() < prog["last_seg_s"] + margin:
             raise _DeadlineStop
 
+    def _make_runner(c):
+        return (runner if c is cfg
+                else ShardedOptimizer(c, n, n_devices=mesh_devices,
+                                      aot_plan=_plan))
+
     try:
         # supervised optimize: OOM demotes repulsion via the ladder and
         # relaunches from the last segment boundary; _DeadlineStop (not an
         # OOM) passes straight through to the window-proofing handler
-        state, losses = sup.run_optimize(
-            lambda c: (runner if c is cfg
-                       else ShardedOptimizer(c, n, n_devices=mesh_devices,
-                                             aot_plan=_plan)),
-            cfg, state, jidx, jval, checkpoint_every=seg,
-            checkpoint_cb=cb, extra_edges=extra, telemetry=telemetry_on)
+        if land:
+            # graftfloor landmark schedule, three phases on ONE absolute
+            # iteration axis (models/tsne.landmark_optimize is the
+            # single-device twin of this segmented form)
+            cfg_land, runner_land = land["cfg_land"], land["runner_land"]
+            land_iters = land["land_iters"]
+            lm_j = jnp.asarray(land["lm"])
+            st_l = type(state)(y=state.y[lm_j],
+                               update=state.update[lm_j],
+                               gains=state.gains[lm_j])
+            state_l, losses_l = sup.run_optimize(
+                lambda c: (runner_land if c is cfg_land else
+                           ShardedOptimizer(c, land_info["n_landmark"],
+                                            n_devices=mesh_devices,
+                                            aot_plan=land["plan"])),
+                cfg_land, st_l, land["sub_idx"], land["sub_val"],
+                checkpoint_every=seg, checkpoint_cb=cb,
+                telemetry=telemetry_on)
+            # placement: graftserve's interpolation init onto the frozen
+            # landmarks (serve/transform — the same math, reused)
+            from tsne_flink_tpu.ops.affinities import (
+                landmark_placement_rows)
+            from tsne_flink_tpu.serve.transform import interpolation_init
+            y_land = state_l.y
+            ridx, rval = landmark_placement_rows(jidx, jval, land["lm"])
+            y0 = interpolation_init(rval, ridx, y_land)
+            y_full0 = y0.at[lm_j].set(y_land)
+            state = type(state)(y=y_full0,
+                                update=jnp.zeros_like(y_full0),
+                                gains=jnp.ones_like(y_full0))
+            n_slots = max(cfg.n_loss_slots, 1)
+            lc = jnp.zeros((n_slots,), y_full0.dtype)
+            n1 = min(land_iters // LOSS_EVERY, n_slots)
+            if n1:
+                lc = lc.at[:n1].set(jnp.asarray(losses_l)[:n1])
+            # joint polish: the tail segment of the SAME schedule —
+            # absolute iterations [tail_start, iters), exaggeration off,
+            # final momentum, landmark-phase KL spliced into early slots
+            state, losses = sup.run_optimize(
+                _make_runner, cfg, state, jidx, jval,
+                start_iter=land_iters, loss_carry=lc,
+                checkpoint_every=seg, checkpoint_cb=cb,
+                extra_edges=extra, telemetry=telemetry_on)
+        else:
+            state, losses = sup.run_optimize(
+                _make_runner, cfg, state, jidx, jval,
+                checkpoint_every=seg, checkpoint_cb=cb, extra_edges=extra,
+                telemetry=telemetry_on)
         it_done = iters
     except _DeadlineStop:
         state, losses = prog["state"], prog["losses"]
@@ -783,10 +950,29 @@ def main():
           f"{jax.default_backend()} device(s)), KL={final_kl}",
           file=sys.stderr)
 
-    f_opt_done = optimize_flops(n, s, 2, max(it_done, 1), repulsion,
-                                nnz_pairs=pairs if use_edges else None,
-                                theta=cfg.theta,
-                                mpad=8 if backend == "tpu" else 3)
+    if complete and int(state.y.shape[0]) == n:
+        # graftfloor satellite: the per-term cost split, probed on the
+        # finished full-shape state (skipped when the deadline stopped a
+        # landmark phase early — the state is subsample-shaped then)
+        # graftlint: disable=exception-hygiene -- a failed measurement
+        # probe must never cost the run its final record; the failure is
+        # printed and the field stays None
+        try:
+            base["step_split"] = _step_split_probe(
+                cfg, state, jidx, jval, extra,
+                reps=max(3, min(10, iters // LOSS_EVERY)))
+        except Exception as e:
+            print(f"# step_split probe failed: {e}", file=sys.stderr)
+
+    if land:
+        # two-phase workload: scale the phase-sum model by completed
+        # fraction (extrapolated records only; complete runs use f_opt)
+        f_opt_done = f_opt * max(it_done, 1) / iters
+    else:
+        f_opt_done = optimize_flops(n, s, 2, max(it_done, 1), repulsion,
+                                    nnz_pairs=pairs if use_edges else None,
+                                    theta=cfg.theta,
+                                    mpad=8 if backend == "tpu" else 3)
     # FLOPs EXECUTED this run: cache-loaded stages contribute zero (their
     # arithmetic was paid by the cold run that populated the artifact), so
     # a warm run's MFU cannot be inflated by work it never did.  For a

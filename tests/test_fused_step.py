@@ -1,0 +1,157 @@
+"""graftfloor fused-step tests (ISSUE 16).
+
+* policy: ``pick_fused_step`` arms fusion by default, ``off`` disarms;
+* single-device fused vs unfused one-step: the integration chain runs on
+  the SAME grad bits, so update/gains agree exactly (y may differ by
+  centering compile-order ULPs only);
+* mesh program: fused ON == fused OFF bit-for-bit (the mesh centering
+  sums the gathered array in one fixed order, so fusion cannot reorder
+  it) — the fusion-off byte-identity contract at the program level;
+* mesh 1 == mesh 4 bit-for-bit with fusion ON through a csr layout with
+  a REAL overflow tail (TSNE_ATTRACTION_WIDTH pinned tiny);
+* interpret-mode Pallas fused kernel vs the XLA fused twin: forces +
+  integration parity on ties-free inputs, gains exactly equal.
+"""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tsne_flink_tpu.models.tsne import (TsneConfig, init_working_set,
+                                        optimize)
+from tsne_flink_tpu.ops.affinities import (joint_distribution,
+                                           pairwise_affinities,
+                                           plan_attraction)
+from tsne_flink_tpu.ops.attraction_pallas import (_run_fused, _xla_fused,
+                                                  build_csr, pick_fused_step)
+from tsne_flink_tpu.parallel.mesh import ShardedOptimizer
+
+pytestmark = pytest.mark.fast
+
+
+def _graph(n=160, k=8, seed=0, hub=True):
+    rng = np.random.default_rng(seed)
+    idx = np.empty((n, k), np.int64)
+    for i in range(n):
+        idx[i] = rng.choice([j for j in range(n) if j != i], k,
+                            replace=False)
+        if hub and i > 0:
+            idx[i, 0] = 0
+    dist = rng.random((n, k)) + 0.05
+    p = pairwise_affinities(jnp.asarray(dist), 5.0)
+    return joint_distribution(jnp.asarray(idx, jnp.int32), p)
+
+
+def test_pick_fused_step_policy(monkeypatch):
+    monkeypatch.delenv("TSNE_FUSED_STEP", raising=False)
+    assert pick_fused_step() is True      # auto default: fusion armed
+    monkeypatch.setenv("TSNE_FUSED_STEP", "on")
+    assert pick_fused_step() is True
+    monkeypatch.setenv("TSNE_FUSED_STEP", "off")
+    assert pick_fused_step() is False
+
+
+def test_fused_one_step_matches_unfused_single_device():
+    """The fused kernel consumes the same grad bits as the unfused
+    program (same operand grouping, asserted here at one step): the vdM
+    update and gains are EXACTLY equal; y picks up at most centering
+    compile-order ULPs."""
+    n = 180
+    jidx, jval = _graph(n, 7, seed=1)
+    layout, w = plan_attraction(jidx, jval, "auto")
+    assert layout == "csr"
+    head, tail = build_csr(jidx, jval, w)
+    csr = head + tail
+    cfg = TsneConfig(iterations=30, repulsion="exact", exact_impl="xla")
+    st0 = init_working_set(jax.random.key(3), n, 2, jnp.float64)
+    # fused_step is a trace-time static: bake it into the partial
+    one_f = jax.jit(partial(optimize, cfg=cfg, num_iters=1, fused_step=True))
+    one_u = jax.jit(partial(optimize, cfg=cfg, num_iters=1, fused_step=False))
+    s_f, _ = one_f(st0, jidx, jval, csr=csr)
+    s_u, _ = one_u(st0, jidx, jval, csr=csr)
+    np.testing.assert_array_equal(np.asarray(s_f.update),
+                                  np.asarray(s_u.update))
+    np.testing.assert_array_equal(np.asarray(s_f.gains),
+                                  np.asarray(s_u.gains))
+    np.testing.assert_allclose(np.asarray(s_f.y), np.asarray(s_u.y),
+                               rtol=0, atol=1e-12)
+
+
+def test_mesh_program_fused_on_equals_off_bitwise(monkeypatch):
+    """Under the mesh program the centering sums the all-gathered array
+    in one fixed order on every path, so arming fusion changes NOTHING:
+    the full run is bit-identical to the unfused (r12) program — the
+    fusion-off byte-identity contract, observed from the outputs."""
+    n = 131
+    jidx, jval = _graph(n, 6, seed=2, hub=True)
+    cfg = TsneConfig(iterations=25, repulsion="exact", exact_impl="xla",
+                     attraction="csr", row_chunk=8)
+    st = init_working_set(jax.random.key(0), n, 2, jnp.float64)
+    outs = {}
+    for mode in ("on", "off"):
+        monkeypatch.setenv("TSNE_FUSED_STEP", mode)
+        r = ShardedOptimizer(cfg, n, n_devices=4)
+        s2, l2 = r(st, jidx, jval)
+        outs[mode] = (np.asarray(s2.y), np.asarray(l2))
+    np.testing.assert_array_equal(outs["on"][0], outs["off"][0])
+    np.testing.assert_array_equal(outs["on"][1], outs["off"][1])
+
+
+def test_mesh_bit_identity_fused_with_real_tail(monkeypatch):
+    """mesh 1 == mesh 4 bit-for-bit with fusion ON through a csr layout
+    whose overflow tail is NON-EMPTY (width pinned tiny on a hub graph)
+    — the graftmesh contract extended to the fused step."""
+    n = 131
+    jidx, jval = _graph(n, 6, seed=2, hub=True)
+    monkeypatch.setenv("TSNE_FUSED_STEP", "on")
+    monkeypatch.setenv("TSNE_ATTRACTION_WIDTH", "8")
+    cfg = TsneConfig(iterations=25, repulsion="exact", exact_impl="xla",
+                     attraction="csr", row_chunk=8)
+    st = init_working_set(jax.random.key(0), n, 2, jnp.float64)
+    outs = {}
+    for d in (1, 4):
+        r = ShardedOptimizer(cfg, n, n_devices=d)
+        layout, _, w = r.attraction_plan(jidx, jval)
+        assert layout == "csr" and w == 8
+        deg = np.count_nonzero(np.asarray(jval) > 0, axis=1)
+        assert int(np.maximum(deg - w, 0).sum()) > 0, "need a real tail"
+        s2, l2 = r(st, jidx, jval)
+        outs[d] = (np.asarray(s2.y), np.asarray(l2))
+    np.testing.assert_array_equal(outs[4][0], outs[1][0])
+    np.testing.assert_array_equal(outs[4][1], outs[1][1])
+
+
+def test_fused_interpret_pallas_matches_xla_twin():
+    """Ties-free inputs: the interpret-mode Pallas fused kernel and the
+    XLA fused twin agree to float noise on y/update/gsq; the gains
+    ladder (a sign comparison + piecewise step) is EXACTLY equal."""
+    rng = np.random.default_rng(3)
+    c, w, m = 24, 32, 2
+    yc = jnp.asarray(rng.standard_normal((c, m)), jnp.float32)
+    yj = jnp.asarray(rng.standard_normal((c, w, m)), jnp.float32)
+    val = jnp.asarray(rng.random((c, w)), jnp.float32)
+    val = val.at[:, -5:].set(0.0)          # padding lanes contribute zero
+    tail = jnp.asarray(0.1 * rng.standard_normal((c, m)), jnp.float32)
+    repz = jnp.asarray(0.1 * rng.standard_normal((c, m)), jnp.float32)
+    mask = jnp.ones((c,), jnp.float32).at[-3:].set(0.0)  # padded rows
+    upd = jnp.asarray(0.01 * rng.standard_normal((c, m)), jnp.float32)
+    gains = jnp.asarray(1.0 + rng.random((c, m)), jnp.float32)
+    exag = jnp.asarray(4.0, jnp.float32)
+    momentum = jnp.asarray(0.5, jnp.float32)
+    out_p = _run_fused(yc, yj, val, tail, repz, mask, upd, gains,
+                       exag, momentum, 200.0, 0.01, interpret=True)
+    out_x = _xla_fused(yc, yj, val, tail, repz, mask, upd, gains,
+                       exag, momentum, 200.0, 0.01)
+    y_p, u_p, g_p, q_p = map(np.asarray, out_p)
+    y_x, u_x, g_x, q_x = map(np.asarray, out_x)
+    np.testing.assert_array_equal(g_p, g_x)
+    np.testing.assert_allclose(y_p, y_x, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(u_p, u_x, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(q_p, q_x, rtol=1e-4, atol=1e-6)
+    # padded rows: zero grad -> pure momentum decay, identical on both
+    np.testing.assert_allclose(u_p[-3:], 0.5 * np.asarray(upd)[-3:],
+                               rtol=1e-6, atol=0)

@@ -357,6 +357,13 @@ contract("ops.attraction_pallas._run_forces",
 contract("ops.attraction_pallas._run_loss",
          "tsne_flink_tpu/ops/attraction_pallas.py", ("float32",),
          trace=False)
+# graftfloor fused step kernel (y', update', gains', grad-sq scalar) —
+# declared-only: runtime-probed like the other Mosaic kernels, and the
+# XLA twin (_xla_fused) carries the same math inside the jitted step.
+contract("ops.attraction_pallas._run_fused",
+         "tsne_flink_tpu/ops/attraction_pallas.py",
+         ("float32", "float32", "float32", "float32"),
+         trace=False)
 
 
 # ---- models/tsne.py ---------------------------------------------------------

@@ -216,7 +216,8 @@ def _optimize_stage(plan: PlanConfig) -> dict:
             plan.attraction == "csr" or edges_beneficial(e_est, n, s)):
         # graftstep capped-width CSR: the [nl, s] source rows stay live
         # (segment operands) + head/tail arrays + the per-chunk tile set
-        from tsne_flink_tpu.ops.attraction_pallas import pick_csr_width
+        from tsne_flink_tpu.ops.attraction_pallas import (pick_csr_width,
+                                                          pick_fused_step)
         w = pick_csr_width(int(e_est), n, s)
         tail = max(0.0, e_est - 0.85 * n * min(w, 2 * k)) / mesh
         p_arrays = (float(nl * s * (4 + isz))          # source P rows
@@ -224,6 +225,12 @@ def _optimize_stage(plan: PlanConfig) -> dict:
                     + tail * (8.0 + isz))              # overflow tail
         attr = (PIPELINE_FACTOR * c * w * (m * isz + 4.0 * isz)
                 + tail * (2.0 * m * isz + 4.0 * isz))
+        if not pick_fused_step():
+            # graftfloor: only the UNFUSED step materializes the full
+            # [nl, m] attraction output + gradient between kernels; the
+            # fused step (the default) keeps them per-row-chunk tiles
+            # already counted above — no dead round-trip buffers
+            attr += 2.0 * nl * m * isz
     else:
         p_arrays = float(nl * s * (4 + isz))
         attr = PIPELINE_FACTOR * c * s * (m * isz + 4.0 * isz)
@@ -239,7 +246,7 @@ def _optimize_stage(plan: PlanConfig) -> dict:
         terms["repulsion_tile"] = c * fr * 3.0 * isz + n * lv * 4.0
     else:  # fft — the graftstep program (repulsion_fft module docstring)
         from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
-        g = DEFAULT_GRID.get(m, 1024)
+        g = getattr(plan, "fft_grid", None) or DEFAULT_GRID.get(m, 1024)
         nch = 1 + m
         taps = 3 ** m                          # interp-order stencil
 
@@ -294,7 +301,7 @@ def _transform_stage(plan: PlanConfig) -> dict:
     model = float(n * d * isz + n * m * isz + n * k * (4 + isz))
     if rep == "fft":
         from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
-        g = DEFAULT_GRID.get(m, 1024)
+        g = getattr(plan, "fft_grid", None) or DEFAULT_GRID.get(m, 1024)
         # precomputed potential volumes: (2 + m) channels at G^m (K1·1
         # for per-row Z, K2·[1, y] for the force), real space only — the
         # spectra are build-time transients, freed before serving

@@ -74,6 +74,11 @@ class PlanConfig:
     #: per device; prepare stays host-staged (single-device) in the
     #: unified pipeline and is not scaled.
     mesh: int = 1
+    #: graftfloor: pinned FFT grid (None = repulsion_fft.DEFAULT_GRID).
+    #: The landmark phase's plan pins the coarse grid here
+    #: (models/autopilot.landmark_grid), so its HBM terms and its AOT
+    #: entry key both see the geometry that actually compiles.
+    fft_grid: int | None = None
     #: graftpilot: the closed-loop approximation autopilot is armed.  The
     #: HBM model then adds the coarse FFT geometry of the phase ladder
     #: (both rungs are pre-hoisted and live for the whole segment), the

@@ -70,6 +70,13 @@ ROUGH_REL = 0.40
 #: guardrail every speed win is gated on (bench A/B + quality script).
 KL_GUARDRAIL_TOL = 0.05
 
+#: smallest dataset where the landmark coarse-to-fine schedule engages
+#: under ``TSNE_LANDMARK=auto``: below this the full-N pass is already
+#: cheap and the subsample/placement overhead eats the win (the 10k
+#: guardrail shape runs landmark-off by default for exactly this
+#: reason — its A/B record arms it explicitly with ``TSNE_LANDMARK=on``).
+LANDMARK_MIN_N = 20_000
+
 #: columns of the on-device policy trace (one row per KL report slot).
 PILOT_TRACE_FIELDS = ("stride", "grid_level", "grad_norm", "trigger")
 
@@ -92,6 +99,82 @@ def tail_start(cfg: TsneConfig) -> int:
     speedup at the 60k bench shape."""
     return max(0, cfg.iterations - max(2 * LOSS_EVERY,
                                        cfg.iterations // 5))
+
+
+def pick_landmark(cfg: TsneConfig, n: int) -> bool:
+    """Does the landmark coarse-to-fine schedule run?  The resolved
+    decision (+ fraction and phase sizes) lands on the bench record's
+    ``policy`` block via :func:`policy_report`.
+
+    ``TSNE_LANDMARK=on|off`` forces it; ``auto`` (default) engages only
+    when the autopilot is armed (the schedule is an autopilot rung — an
+    approximation bought back by the same KL guardrail) and the dataset
+    clears :data:`LANDMARK_MIN_N`.  Resolved ONCE by the driver before
+    the first segment, like every other env policy."""
+    from tsne_flink_tpu.utils.env import env_str
+    mode = env_str("TSNE_LANDMARK")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return bool(getattr(cfg, "autopilot", False)) and n >= LANDMARK_MIN_N
+
+
+def landmark_fraction() -> float:
+    """Subsample fraction for the landmark phase, clamped to a sane
+    open interval — a fraction of 1.0 would degenerate to the plain
+    schedule with extra bookkeeping, and the seeded choice must keep at
+    least a handful of rows."""
+    from tsne_flink_tpu.utils.env import env_float
+    return min(0.9, max(0.01, env_float("TSNE_LANDMARK_FRACTION")))
+
+
+def landmark_points(n: int, seed: int):
+    """Seeded landmark choice: sorted row ids of the subsample (numpy
+    int64).  Deterministic in (n, seed, fraction) — the same pure-function
+    contract every autopilot decision carries, so a resumed or re-run
+    schedule picks the identical subsample.  Sorted ids keep the
+    landmark-local row order a sub-order of the global one (the placement
+    remap and the polish scatter both rely on it)."""
+    import numpy as np
+    frac = landmark_fraction()
+    n_land = max(8, min(n - 1, int(round(n * frac))))
+    rs = np.random.RandomState(seed)
+    return np.sort(rs.choice(n, n_land, replace=False))
+
+
+def landmark_schedule(cfg: TsneConfig) -> tuple[int, int]:
+    """``(landmark_iters, polish_iters)`` split of ``cfg.iterations``.
+
+    The landmark subsample runs the head of the schedule — early
+    exaggeration and the descent — and the joint full-N polish takes
+    exactly the convergence tail (:func:`tail_start`), where final KL
+    is formed.  Reusing the tail boundary keeps ONE pinned notion of
+    'the window that must run exact' across stride control and the
+    landmark schedule."""
+    ts = tail_start(cfg)
+    return ts, cfg.iterations - ts
+
+
+def landmark_grid(cfg: TsneConfig, m: int) -> int | None:
+    """FFT grid for the LANDMARK phase: the full run's coarse rung
+    (half the configured grid, floor 32), or None off the FFT path.
+
+    Coarse-to-fine in grid as well as in N: the subsample carries ~a
+    quarter of the points, so the landmark descent resolves the field
+    at half resolution — at the 60k bench shape the 1024-grid FFT
+    dominates the 15k-row landmark iteration (the spread/gather terms
+    are the only O(N) pieces), and halving it is what takes the phase
+    under the floor.  The phase's OWN autopilot ladder then halves
+    again during its early exaggeration, and the joint polish runs at
+    the full configured grid — final KL forms at full resolution, and
+    the 10k exact-oracle guardrail record gates the whole schedule.
+    Rides the bench record's ``policy`` block as ``landmark_grid``."""
+    if cfg.repulsion != "fft":
+        return None
+    from tsne_flink_tpu.ops.repulsion_fft import DEFAULT_GRID
+    g = cfg.fft_grid if cfg.fft_grid is not None else DEFAULT_GRID.get(m)
+    return max(32, int(g) // 2)
 
 
 def grid_ladder(cfg: TsneConfig, m: int) -> tuple[int, ...]:
@@ -205,26 +288,37 @@ def pilot_update(i, gn, pvec, trace_arr, refreshed, slot, record,
 # ---------------------------------------------------------------------------
 # host-side reporting (bench record `policy` block, trace_report --policy)
 
-def policy_report(cfg: TsneConfig, pilot, iterations_run: int | None = None
-                  ) -> dict:
+def policy_report(cfg: TsneConfig, pilot, iterations_run: int | None = None,
+                  landmark: dict | None = None) -> dict:
     """JSON-safe ``policy`` block for bench records from the run's final
     pilot carry ``(pvec, trace)``: ladder identities, the decision
     transitions (iter, trigger, old -> new stride/grid, grad-norm at
     decision), and the refresh count.  ``pilot=None`` (autopilot off)
-    reports the static policy so the record key is never absent."""
+    reports the static policy so the record key is never absent.
+    ``landmark`` is the driver's resolved coarse-to-fine decision
+    (:func:`pick_landmark` + phase sizes); the keys are always present
+    so record consumers never branch on absence."""
     import numpy as np
     iters = int(iterations_run if iterations_run is not None
                 else cfg.iterations)
     stride = max(1, int(getattr(cfg, "repulsion_stride", 1)))
+    from tsne_flink_tpu.ops.attraction_pallas import pick_fused_step
     base = {
         "autopilot": bool(getattr(cfg, "autopilot", False)),
+        "fused_step": pick_fused_step(),
         "stride_ladder": list(STRIDE_LADDER),
         "grid_ladder": list(grid_ladder(cfg, cfg.n_components)),
         "kl_guardrail_tol": KL_GUARDRAIL_TOL,
         "smooth_rel": SMOOTH_REL, "rough_rel": ROUGH_REL,
         "tail_start": tail_start(cfg),
         "decide_every": LOSS_EVERY,
+        "landmark": False, "landmark_fraction": 0.0, "n_landmark": 0,
+        "landmark_iters": 0, "polish_iters": iters, "landmark_grid": None,
     }
+    if landmark:
+        base.update({k: landmark.get(k, base[k]) for k in
+                     ("landmark", "landmark_fraction", "n_landmark",
+                      "landmark_iters", "polish_iters", "landmark_grid")})
     if pilot is None:
         # static schedule: refreshes = ceil(iters / stride) exactly (the
         # loop refreshes at i % stride == 0 plus the segment starts,

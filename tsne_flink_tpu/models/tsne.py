@@ -144,7 +144,7 @@ def _mesh_sum(per_row, axis_name):
     return jnp.sum(lax.all_gather(per_row, axis_name, tiled=True))
 
 
-def _telemetry_row(st: "TsneState", grad, axis_name, valid):
+def _telemetry_row(st: "TsneState", grad, axis_name, valid, gsq=None):
     """One :data:`TELEMETRY_FIELDS` row from the post-update state: global
     grad L2 norm, gains mean/max, embedding bbox — every value is a global
     scalar, so the row is replication-invariant like the loss trace.
@@ -152,7 +152,12 @@ def _telemetry_row(st: "TsneState", grad, axis_name, valid):
     masked here.  Under a mesh the floating sums are mesh-canonical
     (:func:`_mesh_sum`) so the telemetry trace is bit-identical across
     mesh widths; min/max are exact under any reduction order and keep
-    pmin/pmax, and the count is a sum of exact integers."""
+    pmin/pmax, and the count is a sum of exact integers.
+
+    graftfloor: the fused step never materializes ``grad`` — it returns
+    the per-row squared norms instead; pass them as ``gsq`` (``grad``
+    None) and the norm reduces the per-row vector, which under a mesh is
+    the exact reduction the unfused path already used."""
     dt = st.y.dtype
     if valid is None:
         vm = w = None
@@ -169,10 +174,11 @@ def _telemetry_row(st: "TsneState", grad, axis_name, valid):
         ymax = _pmax(jnp.max(jnp.where(vm, st.y, -jnp.inf)), axis_name)
     gains_m = st.gains if w is None else st.gains * w[:, None]
     if axis_name is None:
-        gn2 = jnp.sum(grad * grad)
+        gn2 = jnp.sum(grad * grad) if gsq is None else jnp.sum(gsq)
         gsum = jnp.sum(gains_m)
     else:
-        gn2 = _mesh_sum(jnp.sum(grad * grad, axis=1), axis_name)
+        gn2 = _mesh_sum(jnp.sum(grad * grad, axis=1) if gsq is None
+                        else gsq, axis_name)
         gsum = _mesh_sum(jnp.sum(gains_m, axis=1), axis_name)
     return jnp.stack([jnp.sqrt(gn2), gsum / gcnt, gmax, ymin,
                       ymax]).astype(dt)
@@ -299,6 +305,13 @@ def _att_kernel() -> str:
     read (``ops/attraction_pallas.pick_attraction_kernel``)."""
     from tsne_flink_tpu.ops.attraction_pallas import pick_attraction_kernel
     return pick_attraction_kernel()
+
+
+def _fused_policy() -> bool:
+    """The resolved fused-step policy for this trace — a static policy
+    read (``ops/attraction_pallas.pick_fused_step``)."""
+    from tsne_flink_tpu.ops.attraction_pallas import pick_fused_step
+    return pick_fused_step()
 
 
 def _attraction_forces(y_local, y_full, jidx, jval, cfg: TsneConfig, exag,
@@ -451,7 +464,7 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
              axis_name=None, row_offset=0, valid=None,
              start_iter=0, num_iters: int | None = None,
              loss_carry=None, edges=None, edges_extra=False, csr=None,
-             with_health=False, with_telemetry=False,
+             fused_step=None, with_health=False, with_telemetry=False,
              telemetry_carry=None, pilot_carry=None):
     """Full 3-phase gradient descent as ONE compiled fori_loop.
 
@@ -493,6 +506,18 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
     stride-th absolute iteration — stride 1 is bit-identical to the
     carried-free program (the carry does not exist).
 
+    graftfloor: ``fused_step`` (static: None = the recorded
+    ``pick_fused_step`` policy, or an explicit bool) arms the FUSED
+    attraction+integration step whenever the CSR layout is armed — the
+    head forces, the tail/repulsion combine and the vdM gains+momentum
+    update run as ONE per-row-chunk kernel
+    (``ops/attraction_pallas.fused_step_update``), vmapped across chunks,
+    so grad/gains/update never round-trip HBM.  Repulsion, the cond-gated
+    KL pass and the centering are computed exactly as the unfused program
+    computes them (same global reductions, same fixed order), so mesh
+    widths stay bit-identical; OFF removes the fused code from the trace
+    entirely — byte-identical to the pre-graftfloor (r12) program.
+
     graftpilot: ``cfg.autopilot`` (static) arms the closed-loop
     approximation controller (``models/autopilot.py``): the repulsion
     (rep, Z) carry's refresh cadence becomes a TRACED stride driven by
@@ -520,6 +545,12 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
                          "approximation policy, not both")
     if ap:
         from tsne_flink_tpu.models import autopilot as pilot
+    # graftfloor: the fused step is a trace-time static — only the CSR
+    # layout has the head/tail split the fused kernel is built around
+    fused = csr is not None and (bool(fused_step) if fused_step is not None
+                                 else _fused_policy())
+    if fused:
+        from tsne_flink_tpu.ops.attraction_pallas import fused_step_update
     # the validity mask is loop-invariant: gather it to global form ONCE here,
     # not inside the fori_loop (XLA does not hoist collectives out of loops)
     valid_full = (valid if axis_name is None or valid is None
@@ -557,7 +588,63 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
         # sentinel armed it must be checked every iteration (None = always)
         record = (i + 1) % LOSS_EVERY == 0
         want_loss = None if with_health else record
-        if stride == 1 and not ap:
+        grad = gsq = None
+        if fused:
+            # graftfloor: rep/Z and the (cond-gated) KL pass stay exactly
+            # as the unfused program computes them — same kernels, same
+            # mesh-canonical reductions in the same fixed order; only the
+            # head forces + tail/repulsion combine + vdM update move into
+            # the fused per-row-chunk kernel
+            y_full = (st.y if axis_name is None
+                      else lax.all_gather(st.y, axis_name, tiled=True))
+            if stride == 1 and not ap:
+                rep_now, z_now = _repulsion(st.y, y_full, cfg, axis_name,
+                                            row_offset, valid_full,
+                                            rep_scratch)
+            else:
+                if ap:
+                    refresh = ((i == start)
+                               | (jnp.mod(i, pilot.stride_of(pvec)) == 0))
+                    if pilot_geoms:
+                        refresh = refresh | (i == cfg.exaggeration_end)
+                else:
+                    refresh = (i == start) | (i % stride == 0)
+                if ap and pilot_geoms:
+                    def _rep_at(geom):
+                        return lambda: _repulsion(st.y, y_full, cfg,
+                                                  axis_name, row_offset,
+                                                  valid_full, geom)
+
+                    def _fresh():
+                        return lax.switch(pilot.grid_phase(i, cfg),
+                                          [_rep_at(g) for g in pilot_geoms])
+                else:
+                    def _fresh():
+                        return _repulsion(st.y, y_full, cfg, axis_name,
+                                          row_offset, valid_full,
+                                          rep_scratch)
+                rep_c, z_c = lax.cond(refresh, _fresh,
+                                      lambda: (rep_c, z_c))
+                rep_now, z_now = rep_c, z_c
+
+            def _loss_rows_f():
+                return _attraction_loss(st.y, y_full, jidx, jval, cfg,
+                                        exag, z_now, edges=edges,
+                                        edges_extra=edges_extra, csr=csr)
+            loss_rows = (_loss_rows_f() if want_loss is None else lax.cond(
+                want_loss, _loss_rows_f,
+                lambda: jnp.zeros((st.y.shape[0],), st.y.dtype)))
+            loss = (_mesh_sum(loss_rows, axis_name)
+                    if axis_name is not None else jnp.sum(loss_rows))
+            hidx, hval, tsrc, tdst, tval = csr
+            tail_att = _edge_forces(st.y, y_full, tsrc, tdst, tval, exag)
+            y2, u2, g2, gsq = fused_step_update(
+                st.y, y_full, hidx, hval, exag, tail_att,
+                rep_now / z_now, valid, st.update, st.gains, momentum,
+                eta=cfg.learning_rate, min_gain=cfg.min_gain,
+                row_chunk=cfg.row_chunk, kernel=_att_kernel())
+            st = TsneState(y=y2, update=u2, gains=g2)
+        elif stride == 1 and not ap:
             grad, loss = _gradient(st.y, jidx, jval, cfg, exag,
                                    axis_name=axis_name,
                                    row_offset=row_offset,
@@ -613,9 +700,10 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
             loss = (_mesh_sum(loss_rows, axis_name)
                     if axis_name is not None else jnp.sum(loss_rows))
             grad = att - rep_c / z_c
-        if valid is not None:
-            grad = grad * valid[:, None].astype(grad.dtype)
-        st = _update_embedding(st, grad, momentum, cfg)
+        if not fused:
+            if valid is not None:
+                grad = grad * valid[:, None].astype(grad.dtype)
+            st = _update_embedding(st, grad, momentum, cfg)
         st = _center(st, axis_name=axis_name, valid=valid)
         slot = jnp.minimum((i + 1) // LOSS_EVERY - 1, n_slots - 1)
         loss_arr = loss_arr.at[slot].set(
@@ -624,7 +712,7 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
         if with_telemetry:
             # telemetry rides the carry like the loss trace: same slot
             # keying, written only at the report interval
-            row = _telemetry_row(st, grad, axis_name, valid)
+            row = _telemetry_row(st, grad, axis_name, valid, gsq=gsq)
             tel_arr = tel_arr.at[slot].set(
                 jnp.where(record, row, tel_arr[slot]))
             out.append(tel_arr)
@@ -642,7 +730,8 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
             if with_telemetry:
                 gn = row[0]
             else:
-                gsq = jnp.sum(grad * grad, axis=1)
+                if gsq is None:
+                    gsq = jnp.sum(grad * grad, axis=1)
                 gn = jnp.sqrt(_mesh_sum(gsq, axis_name)
                               if axis_name is not None else jnp.sum(gsq))
             pvec, ptr_arr = pilot.pilot_update(i, gn, pvec, ptr_arr,
@@ -699,6 +788,120 @@ def optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
                     axis_name)
         res.append(bad == 0)
     return tuple(res)
+
+
+def _plan_layout(jidx, jval, cfg: TsneConfig):
+    """``(edges, csr)`` for the planned attraction layout of one row
+    block — the shared ``plan_attraction`` -> build step of ``tsne_embed``
+    and the landmark phases (graftfloor: each phase re-plans on ITS OWN
+    block, so the landmark subsample derives its own capped head width
+    instead of inheriting the full-N one)."""
+    from tsne_flink_tpu.ops.affinities import (assemble_edges,
+                                               plan_attraction)
+    layout, param = plan_attraction(jidx, jval, cfg.attraction)
+    if layout == "csr":
+        from tsne_flink_tpu.ops.attraction_pallas import build_csr
+        head, tail = build_csr(jidx, jval, param)
+        return None, head + tail
+    if layout == "edges":
+        return jax.jit(partial(assemble_edges, e_pad=param))(jidx, jval), None
+    return None, None
+
+
+def landmark_optimize(state: TsneState, jidx, jval, cfg: TsneConfig, *,
+                      seed: int = 0):
+    """graftfloor's landmark coarse-to-fine schedule, single-device.
+
+    Three phases over ONE absolute iteration axis:
+
+    1. **landmark descent** ``[0, tail_start)`` — optimize the seeded
+       subsample (``models/autopilot.landmark_points``, default ~N/4 via
+       ``TSNE_LANDMARK_FRACTION``) under its OWN joint distribution
+       (``ops/affinities.subsample_affinities``) and its OWN attraction
+       plan — the capped CSR width is re-derived from the subsample's
+       degree distribution, not inherited.
+    2. **placement** — every row starts at the affinity-weighted mean of
+       its landmark neighbors' frozen coordinates: EXACTLY graftserve's
+       ``interpolation_init`` (serve/transform.py) fed by
+       ``landmark_placement_rows``; landmark rows keep their optimized
+       positions, rows with no landmark neighbor start at the origin.
+    3. **joint polish** ``[tail_start, iterations)`` — the full-N
+       optimize as a segment (``start_iter`` = the boundary), so the
+       momentum/exaggeration gates and loss slots read the absolute
+       iteration: the polish runs exact, post-exaggeration, at final
+       momentum — the same window the autopilot already pins stride 1.
+
+    The loss trace's early slots carry the LANDMARK phase's KL (the
+    subsample's own objective — a different normalizer than full-N KL),
+    the tail slots the joint KL; final KL semantics are unchanged.
+
+    Returns ``(y, losses, info)`` — ``info`` is the ``policy``-block
+    landmark dict (``models/autopilot.policy_report``) — or ``None``
+    when the schedule degenerates (too few iterations or points), in
+    which case the caller falls back to the plain program."""
+    from dataclasses import replace
+
+    from tsne_flink_tpu.models.autopilot import (landmark_fraction,
+                                                 landmark_grid,
+                                                 landmark_points,
+                                                 landmark_schedule)
+    from tsne_flink_tpu.ops.affinities import (landmark_placement_rows,
+                                               subsample_affinities)
+    from tsne_flink_tpu.serve.transform import interpolation_init
+
+    n = state.y.shape[0]
+    land_iters, polish = landmark_schedule(cfg)
+    if land_iters < LOSS_EVERY or polish <= 0 or n < 16:
+        return None
+    lm = landmark_points(n, seed)
+    n_land = int(lm.shape[0])
+    if n_land < 8 or n_land >= n:
+        return None
+
+    # phase 1: the subsample's own joint distribution + attraction plan,
+    # at the coarse FFT grid (landmark_grid — half resolution for a
+    # quarter of the points; the polish restores the full grid)
+    sub_idx, sub_val = subsample_affinities(jidx, jval, lm)
+    cfg_land = replace(cfg, iterations=land_iters,
+                       fft_grid=landmark_grid(cfg, state.y.shape[1]))
+    edges_l, csr_l = _plan_layout(sub_idx, sub_val, cfg_land)
+    lm_j = jnp.asarray(lm)
+    st_land = TsneState(y=state.y[lm_j], update=state.update[lm_j],
+                        gains=state.gains[lm_j])
+    # graftlint: disable=jit-hygiene -- one-shot phase runs, not a segment
+    # loop (nothing re-binds state; CPU cannot donate)
+    run1 = jax.jit(partial(optimize, cfg=cfg_land, edges_extra=False))
+    out1 = run1(st_land, sub_idx, sub_val, edges=edges_l, csr=csr_l)
+    y_land = out1[0].y
+
+    # phase 2: graftserve's interpolation init onto the frozen landmarks
+    ridx, rval = landmark_placement_rows(jidx, jval, lm)
+    y0 = interpolation_init(rval, ridx, y_land)
+    y_full = y0.at[lm_j].set(y_land)
+
+    # phase 3: full-N joint polish as a segment of the SAME schedule;
+    # fresh update/gains (the placement moved every row — the landmark
+    # velocity field is stale) and the landmark-phase KL spliced into the
+    # early loss slots
+    st3 = TsneState(y=y_full, update=jnp.zeros_like(y_full),
+                    gains=jnp.ones_like(y_full))
+    edges_f, csr_f = _plan_layout(jidx, jval, cfg)
+    n_slots = max(cfg.n_loss_slots, 1)
+    loss_carry = jnp.zeros((n_slots,), state.y.dtype)
+    n1 = min(land_iters // LOSS_EVERY, n_slots)
+    if n1:
+        loss_carry = loss_carry.at[:n1].set(out1[1][:n1])
+    # graftlint: disable=jit-hygiene -- one-shot phase run, same rationale
+    run3 = jax.jit(partial(optimize, cfg=cfg, edges_extra=False,
+                           num_iters=polish))
+    out3 = run3(st3, jidx, jval, edges=edges_f, csr=csr_f,
+                start_iter=land_iters, loss_carry=loss_carry)
+    info = {"landmark": True,
+            "landmark_fraction": float(landmark_fraction()),
+            "n_landmark": n_land, "landmark_iters": land_iters,
+            "polish_iters": polish,
+            "landmark_grid": cfg_land.fft_grid}
+    return out3[0].y, out3[1], info
 
 
 def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
@@ -758,17 +961,17 @@ def tsne_embed(x: jnp.ndarray, cfg: TsneConfig | None = None, *,
         # policy-aware callers run the segmented ShardedOptimizer path
         out = run_blocks(state, jidx, jval, edges=extra)
         return out[0].y, out[1]
+    # graftfloor: the landmark coarse-to-fine schedule (row layouts only —
+    # the blocks path above returns before this; its edge-direct layout
+    # has no row restriction).  Degenerate schedules fall through to the
+    # plain program.
+    from tsne_flink_tpu.models.autopilot import pick_landmark
+    if pick_landmark(cfg, n):
+        got = landmark_optimize(state, jidx, jval, cfg, seed=seed)
+        if got is not None:
+            return got[0], got[1]
     # graftlint: disable=jit-hygiene -- one-shot run, same rationale as above
     run = jax.jit(partial(optimize, cfg=cfg, edges_extra=False))
-    edges = csr = None
-    from tsne_flink_tpu.ops.affinities import (assemble_edges,
-                                               plan_attraction)
-    layout, param = plan_attraction(jidx, jval, cfg.attraction)
-    if layout == "csr":
-        from tsne_flink_tpu.ops.attraction_pallas import build_csr
-        head, tail = build_csr(jidx, jval, param)
-        csr = head + tail
-    elif layout == "edges":
-        edges = jax.jit(partial(assemble_edges, e_pad=param))(jidx, jval)
+    edges, csr = _plan_layout(jidx, jval, cfg)
     out = run(state, jidx, jval, edges=edges, csr=csr)
     return out[0].y, out[1]

@@ -713,3 +713,106 @@ def joint_distribution(idx: jnp.ndarray, p: jnp.ndarray,
     if return_row_deg:
         out.append(row_deg)
     return tuple(out)
+
+
+def _compact_kept_rows(nbr, vals, keep):
+    """Stable left-compaction of the kept entries of a row layout (host
+    numpy).  Scatter-based: ``np.nonzero`` walks the mask row-major (so
+    within-row order is preserved), per-row ranks come from the row
+    offsets, and the kept entries scatter straight into a fresh
+    ``[N, W]`` block at the subset's own lane-rounded max degree — no
+    ``[N, S]`` argsort or fancy-gather temporaries, which at the 60k
+    bench layout (~2e8 entries) cost ~40 s against ~3 s for this path.
+
+    Returns ``(out_idx [N, W], out_val [N, W])`` in the input dtypes.
+    """
+    import numpy as np
+    n = keep.shape[0]
+    rr, cc = np.nonzero(keep)
+    counts = np.bincount(rr, minlength=n)
+    w = int(max(8, -(-int(counts.max(initial=0)) // 8) * 8))
+    starts = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos = np.arange(rr.size, dtype=np.int64) - starts[rr]
+    out_idx = np.zeros((n, w), nbr.dtype)
+    out_val = np.zeros((n, w), vals.dtype)
+    out_idx[rr, pos] = nbr[rr, cc]
+    out_val[rr, pos] = vals[rr, cc]
+    return out_idx, out_val
+
+
+def subsample_affinities(jidx, jval, landmarks):
+    """Restrict a symmetrized row layout to a landmark subset: keep only
+    edges with BOTH endpoints in ``landmarks`` (sorted row ids), remap ids
+    to [0, L), compact each row left, trim to the subset's own lane-rounded
+    max degree, and renormalize globally (ΣP == 1, :data:`P_FLOOR` floor)
+    exactly like :func:`joint_distribution`.
+
+    This is the landmark phase's CSR re-plan entrance (graftfloor): the
+    returned layout has the SUBSET's width and degree distribution, so the
+    downstream :func:`plan_attraction` / ``pick_csr_width`` pass re-derives
+    the capped head width from the landmark graph instead of inheriting the
+    full-N plan — a subsample keeps ~fraction² of the edges and a narrower
+    head, and an overflow tail triggered only here re-compacts instead of
+    truncating (pinned by tests/test_landmark.py).
+
+    Dropping cross-edges (landmark <-> non-landmark mass) changes row sums,
+    which is why the result is re-normalized as its own joint distribution
+    — the landmark phase optimizes the subsample's OWN t-SNE objective, as
+    in van der Maaten's landmark recipe.  Host numpy; preprocessing only.
+
+    Returns ``(sub_idx [L, W'] int32, sub_val [L, W'])``.
+    """
+    import numpy as np
+    # graftlint: disable=host-sync -- one-shot host preprocessing before
+    # the landmark phase compiles; P is already host-resident here
+    ji, jv = np.asarray(jidx), np.asarray(jval)
+    # graftlint: disable=host-sync -- host-side landmark id vector
+    lm = np.asarray(landmarks, np.int64)
+    n = ji.shape[0]
+    l = lm.shape[0]
+    remap = np.full((n,), -1, np.int32)
+    remap[lm] = np.arange(l, dtype=np.int32)
+    rows = remap[ji[lm]]                 # [L, S]; -1 = neighbor not kept
+    vals = jv[lm]
+    keep = (vals > 0) & (rows >= 0)
+    sub_idx, sub_val = _compact_kept_rows(rows, vals, keep)
+    total = float(sub_val.sum())
+    if total <= 0.0:
+        total = 1.0  # degenerate subset: all-zero rows stay all-zero
+    valid = sub_val > 0
+    sub_val = np.where(valid, np.maximum(sub_val / total, P_FLOOR), 0.0)
+    return (jnp.asarray(sub_idx.astype(np.int32)),
+            jnp.asarray(sub_val.astype(jv.dtype)))
+
+
+def landmark_placement_rows(jidx, jval, landmarks):
+    """Per-row CONDITIONAL affinities onto the landmark set, for the
+    graftserve interpolation init (``serve/transform.interpolation_init``):
+    for every row of the full layout, keep only entries whose neighbor is
+    a landmark, remap neighbor ids to [0, L), left-compact, trim to the
+    lane-rounded max kept degree, and normalize EACH ROW to sum 1 — the
+    serving path's conditional ``P_{j|i}`` over base (= landmark) rows,
+    built from the already-symmetrized P instead of a fresh kNN + beta
+    search (the neighborhood structure is the same graph).  Rows with no
+    landmark neighbor stay all-zero, so the init lands them at the origin
+    (the joint polish pulls them in).  Host numpy; preprocessing only.
+
+    Returns ``(ridx [N, W] int32 landmark-LOCAL ids, rval [N, W])``.
+    """
+    import numpy as np
+    # graftlint: disable=host-sync -- one-shot host preprocessing at the
+    # placement boundary; P is already host-resident here
+    ji, jv = np.asarray(jidx), np.asarray(jval)
+    # graftlint: disable=host-sync -- host-side landmark id vector
+    lm = np.asarray(landmarks, np.int64)
+    n = ji.shape[0]
+    remap = np.full((n,), -1, np.int32)
+    remap[lm] = np.arange(lm.shape[0], dtype=np.int32)
+    nbr = remap[ji]
+    keep = (jv > 0) & (nbr >= 0)
+    ridx, rval = _compact_kept_rows(nbr, jv, keep)
+    row_sum = rval.sum(axis=1, keepdims=True)
+    rval = np.where(row_sum > 0, rval / np.maximum(row_sum, 1e-300), 0.0)
+    return (jnp.asarray(ridx.astype(np.int32)),
+            jnp.asarray(rval.astype(jv.dtype)))

@@ -256,6 +256,94 @@ def _run_loss(yc, yj, val, exag, z, *, interpret=False, row_tile=TILE_ROWS):
     return loss[:c, 0].astype(yc.dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret", "row_tile"))
+def _run_fused(yc, yj, val, tail, repz, maskc, upd, gains, exag, momentum,
+               eta, min_gain, *, interpret=False, row_tile=TILE_ROWS):
+    """Pallas fused step for one chunk -> (y, update, gains [c, m], gsq [c])."""
+    c, m = yc.shape
+    w = yj.shape[1]
+    f32 = jnp.float32
+    rt = min(row_tile, c)
+
+    def rows2(a):
+        return _pad_rows(jnp.pad(a.astype(f32), ((0, 0), (0, MPAD - m))), rt)
+
+    ycp = rows2(yc)
+    yjp = _pad_rows(jnp.pad(yj.astype(f32),
+                            ((0, 0), (0, 0), (0, MPAD - m))), rt)
+    vp = _pad_rows(val.astype(f32), rt)
+    tp, rp, up, gp = rows2(tail), rows2(repz), rows2(upd), rows2(gains)
+    mp = _pad_rows(maskc.astype(f32).reshape(-1, 1), rt)
+    nb = ycp.shape[0] // rt
+    sc = jnp.stack([jnp.asarray(exag, f32), jnp.asarray(momentum, f32),
+                    jnp.asarray(eta, f32),
+                    jnp.asarray(min_gain, f32)]).reshape(1, 4)
+    row_spec = pl.BlockSpec((rt, MPAD), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((rt, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    y2, u2, g2, q2 = pl.pallas_call(
+        _fused_kernel,
+        grid=(nb,),
+        in_specs=[
+            row_spec,
+            pl.BlockSpec((rt, w, MPAD), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rt, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            row_spec, row_spec, col_spec, row_spec, row_spec,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[row_spec, row_spec, row_spec, col_spec],
+        out_shape=[jax.ShapeDtypeStruct((nb * rt, MPAD), f32)] * 3
+        + [jax.ShapeDtypeStruct((nb * rt, 1), f32)],
+        cost_estimate=pl.CostEstimate(
+            flops=float(nb * rt) * (w * (5.0 * MPAD + 9.0) + 10.0 * MPAD),
+            bytes_accessed=float(nb * rt) * (w * (MPAD + 2.0)
+                                             + 9.0 * MPAD) * 4.0,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(ycp, yjp, vp, tp, rp, mp, up, gp, sc)
+    dt = yc.dtype
+    return (y2[:c, :m].astype(dt), u2[:c, :m].astype(dt),
+            g2[:c, :m].astype(dt), q2[:c, 0].astype(dt))
+
+
+def _fused_kernel(yc_ref, yj_ref, val_ref, tail_ref, repz_ref, mask_ref,
+                  upd_ref, gains_ref, sc_ref,
+                  y_ref, updo_ref, gainso_ref, gsq_ref):
+    """One [TR, W] row tile of the FUSED step (graftfloor): head forces +
+    precomputed tail/repulsion combine -> vdM adaptive gains -> momentum
+    integration, all in one kernel so grad/gains/update never round-trip
+    HBM between the attraction and integration passes.  ``sc`` carries the
+    traced scalars [exag, momentum, eta, min_gain] in SMEM."""
+    yc = yc_ref[:]                                   # [TR, MPAD]
+    yj = yj_ref[:]                                   # [TR, W, MPAD]
+    val = val_ref[:]                                 # [TR, W]
+    d2 = (jnp.sum(yc * yc, axis=1, keepdims=True)
+          + jnp.sum(yj * yj, axis=2)
+          - 2.0 * jnp.sum(yc[:, None, :] * yj, axis=2))
+    q = 1.0 / (1.0 + jnp.maximum(d2, 0.0))           # [TR, W]
+    w = val * sc_ref[0, 0] * q
+    att = (yc * jnp.sum(w, axis=1, keepdims=True)
+           - jnp.sum(w[:, :, None] * yj, axis=1))
+    # (head + tail) - rep/Z, then the padded-row mask — the SAME operand
+    # grouping as the unfused program (float addition is not associative;
+    # regrouping would break the fusion-off bit-identity pin)
+    grad = ((att + tail_ref[:]) - repz_ref[:]) * mask_ref[:]
+    upd = upd_ref[:]
+    same_sign = (grad > 0.0) == (upd > 0.0)
+    gains = jnp.maximum(
+        jnp.where(same_sign, gains_ref[:] * 0.8, gains_ref[:] + 0.2),
+        sc_ref[0, 3])
+    upd = sc_ref[0, 1] * upd - sc_ref[0, 2] * gains * grad
+    y_ref[:] = yc + upd
+    updo_ref[:] = upd
+    gainso_ref[:] = gains
+    gsq_ref[:] = jnp.sum(grad * grad, axis=1, keepdims=True)
+
+
 # ---- XLA twins --------------------------------------------------------------
 
 def _xla_forces(yc, yj, val, exag):
@@ -284,6 +372,23 @@ def _xla_loss(yc, yj, val, exag, z):
     return jnp.sum(terms, axis=1)
 
 
+def _xla_fused(yc, yj, val, tail, repz, maskc, upd, gains, exag, momentum,
+               eta, min_gain):
+    """XLA twin of the fused step: the head math is :func:`_xla_forces`
+    VERBATIM (the same bits as the unfused twin), then the integration
+    chain of ``models/tsne._update_embedding`` inlined per chunk, with
+    the unfused program's exact operand grouping — ``(head + tail)`` in
+    the native (possibly promoted) dtype, cast to the state dtype, THEN
+    the repulsion subtract and padded-row mask."""
+    att = (_xla_forces(yc, yj, val, exag) + tail).astype(yc.dtype)
+    grad = (att - repz) * maskc[:, None]
+    same_sign = (grad > 0.0) == (upd > 0.0)
+    gains = jnp.maximum(jnp.where(same_sign, gains * 0.8, gains + 0.2),
+                        min_gain)
+    upd = momentum * upd - eta * gains * grad
+    return yc + upd, upd, gains, jnp.sum(grad * grad, axis=1)
+
+
 # ---- chunked entry points ---------------------------------------------------
 
 def _chunked(y_local, jidx, jval, row_chunk):
@@ -297,6 +402,14 @@ def _chunked(y_local, jidx, jval, row_chunk):
     vp = jnp.pad(jval, ((0, pad), (0, 0)))
     return (yp.reshape(nchunks, c, m), ip.reshape(nchunks, c, s),
             vp.reshape(nchunks, c, s)), nloc, c
+
+
+def _chunk_rows(a, nchunks, c):
+    """Chunk an extra per-row operand with the same zero padding as
+    :func:`_chunked` — the fused step's tail/repulsion/mask/state planes."""
+    pad = nchunks * c - a.shape[0]
+    ap = jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+    return ap.reshape((nchunks, c) + a.shape[1:])
 
 
 def _resolve(kernel, s):
@@ -345,6 +458,50 @@ def attraction_loss(y_local, y_full, jidx, jval, exag, z, *,
 
     loss = lax.map(one_chunk, (yc, ic, vc))
     return loss.reshape(-1)[:nloc]
+
+
+def fused_step_update(y_local, y_full, jidx, jval, exag, tail_att, repz,
+                      valid, update, gains, momentum, *, eta, min_gain,
+                      row_chunk: int = 4096, kernel: str | None = None):
+    """THE fused attraction+integration step (graftfloor): per row chunk,
+    compute the CSR-head forces, fold in the precomputed tail forces and
+    repulsion term (``repz`` = rep/Z), and run the vdM gains+momentum
+    integration — one dispatch per chunk, **vmapped** across chunks so
+    XLA parallelizes the row axis (replacing the sequential ``lax.map``
+    walk of :func:`attraction_forces`), and y/update/gains never
+    round-trip HBM between the attraction and integration passes.
+
+    Per-row math only — the same bits at ANY chunking — so the graftmesh
+    bit-identity contract holds: the global reductions (Z, loss,
+    centering) stay outside in ``models/tsne`` in their one fixed order.
+    ``valid`` is the padded-row mask ([nloc] or None); ``eta``/
+    ``min_gain`` are the static config floats.  Returns ``(y, update,
+    gains, gsq)`` with ``gsq`` the per-row squared grad norms — the
+    mesh-canonical form telemetry and the autopilot reduce via
+    ``_mesh_sum`` (the fused step's replacement for materializing
+    ``grad``)."""
+    kern = _resolve(kernel, jidx.shape[1])
+    (yc, ic, vc), nloc, c = _chunked(y_local, jidx, jval, row_chunk)
+    nchunks = yc.shape[0]
+    m = y_local.shape[1]
+    maskv = (jnp.ones((nloc,), y_local.dtype) if valid is None
+             else valid.astype(y_local.dtype))
+    tc, rc, uc, gc = (_chunk_rows(a, nchunks, c)
+                      for a in (tail_att, repz, update, gains))
+    mc = _chunk_rows(maskv, nchunks, c)
+
+    def one_chunk(ycc, icc, vcc, tcc, rcc, mcc, ucc, gcc):
+        yj = y_full[icc]
+        if kern.startswith("pallas"):
+            return _run_fused(ycc, yj, vcc, tcc, rcc, mcc, ucc, gcc,
+                              exag, momentum, eta, min_gain,
+                              interpret=kern == "pallas-interpret")
+        return _xla_fused(ycc, yj, vcc, tcc, rcc, mcc, ucc, gcc,
+                          exag, momentum, eta, min_gain)
+
+    y2, u2, g2, q2 = jax.vmap(one_chunk)(yc, ic, vc, tc, rc, mc, uc, gc)
+    return (y2.reshape(-1, m)[:nloc], u2.reshape(-1, m)[:nloc],
+            g2.reshape(-1, m)[:nloc], q2.reshape(-1)[:nloc])
 
 
 # ---- kernel selection policy ------------------------------------------------
@@ -405,3 +562,17 @@ def pick_attraction_kernel(backend: str | None = None) -> str:
         if jax.default_backend() != "tpu" or mosaic_attraction_supported():
             return "pallas"
     return "xla"
+
+
+def pick_fused_step() -> bool:
+    """THE fused-step policy, recorded on the bench record's ``policy``
+    block as ``fused_step``: ``TSNE_FUSED_STEP`` = ``auto`` (default) | ``on``
+    | ``off``.  ``auto`` arms fusion whenever the CSR layout is armed —
+    the fused twin pair covers both kernels (:func:`pick_attraction_kernel`
+    still selects Pallas vs XLA for the head math, and the same VMEM
+    demotion rule applies via :func:`_resolve`); ``off`` keeps the
+    optimize program byte-identical to the unfused (r12) trace — the
+    fused branch is a trace-time static, so OFF means the fused code
+    does not exist in the compiled program."""
+    from tsne_flink_tpu.utils.env import env_str
+    return env_str("TSNE_FUSED_STEP") != "off"

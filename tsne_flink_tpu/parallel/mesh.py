@@ -197,7 +197,7 @@ class ShardedOptimizer:
                     trace_edge_pad: int | None = None,
                     edges_extra: bool = False, with_health: bool = False,
                     with_telemetry: bool = False, with_csr: bool = False,
-                    with_pilot: bool = False):
+                    with_pilot: bool = False, fused_step: bool = False):
         """``with_edges``: host-prebuilt edge arrays ride as extra inputs.
         ``with_csr``: the capped-width CSR attraction layout (graftstep)
         rides as five point-sharded arrays (head [N, W] idx/val + the
@@ -216,9 +216,13 @@ class ShardedOptimizer:
         replicated controller state + policy trace pair rides as one
         extra input/output, threaded across segments like the telemetry
         carry (every pilot value is mesh-canonical, so the pair is
-        identical on all shards)."""
+        identical on all shards).  ``fused_step``: graftfloor — the fused
+        attraction+integration step (resolved ONCE by the caller from
+        ``pick_fused_step`` so a mid-run env flip cannot retrace or load
+        a stale AOT executable; part of the memo/AOT key)."""
         key = (num_iters, with_edges, trace_edge_pad, edges_extra,
-               with_health, with_telemetry, with_csr, with_pilot)
+               with_health, with_telemetry, with_csr, with_pilot,
+               fused_step)
         if key in self._fns:
             return self._fns[key]
         cfg_ = self.cfg
@@ -240,6 +244,7 @@ class ShardedOptimizer:
                             start_iter=start_iter, num_iters=num_iters,
                             loss_carry=loss_carry, edges=edges,
                             edges_extra=edges_extra, csr=csr,
+                            fused_step=fused_step,
                             with_health=with_health,
                             with_telemetry=with_telemetry,
                             telemetry_carry=tel_carry,
@@ -467,7 +472,8 @@ class ShardedOptimizer:
         fn = self._segment_fn(self.cfg.iterations,
                               with_edges=edges is not None,
                               with_csr=csr is not None,
-                              with_pilot=with_pilot)
+                              with_pilot=with_pilot,
+                              fused_step=self._fused(csr))
         args = [state, jidx, jval, valid, 0, self._loss0(state.y.dtype)]
         if edges is not None:
             args.append(edges)
@@ -481,6 +487,13 @@ class ShardedOptimizer:
         from tsne_flink_tpu.models import autopilot as pilot
         return (pilot.pilot_init(self.cfg, dtype),
                 pilot.trace_init(self.cfg, dtype))
+
+    def _fused(self, csr) -> bool:
+        """The graftfloor fused-step arming for this run: CSR layout AND
+        the recorded ``pick_fused_step`` policy — resolved once per run
+        so it can ride the segment memo/AOT keys."""
+        from tsne_flink_tpu.ops.attraction_pallas import pick_fused_step
+        return csr is not None and pick_fused_step()
 
     def _run_segment(self, fn, state, jidx, jval, valid, start, losses,
                      edges=None, csr=None, tel=None,
@@ -620,6 +633,7 @@ class ShardedOptimizer:
         from tsne_flink_tpu.runtime import faults
         inj = faults.injector()
         total = self.cfg.iterations
+        fused = self._fused(csr)
         seg = (checkpoint_every if checkpoint_every
                and checkpoint_cb is not None else total - start_iter)
         it = start_iter
@@ -631,7 +645,7 @@ class ShardedOptimizer:
                 break
             seg_key = (step, edges is not None, trace_pad,
                        extra_edges is not None, health_check, telemetry,
-                       csr is not None, with_pilot)
+                       csr is not None, with_pilot, fused)
             fn = self._maybe_aot(
                 self._segment_fn(step, with_edges=edges is not None,
                                  trace_edge_pad=trace_pad,
@@ -639,7 +653,8 @@ class ShardedOptimizer:
                                  with_health=health_check,
                                  with_telemetry=telemetry,
                                  with_csr=csr is not None,
-                                 with_pilot=with_pilot), seg_key)
+                                 with_pilot=with_pilot,
+                                 fused_step=fused), seg_key)
             seg_index += 1
             run_state = state
             if inj is not None:

@@ -92,6 +92,17 @@ def pick_transform_eta(eta: float | None = None) -> float:
     return float(got) if got else 0.5
 
 
+def interpolation_init(p, idx, yb):
+    """The graftserve interpolation init, shared math: each row starts at
+    the affinity-weighted mean of its neighbors' frozen coordinates
+    (``y0_i = Σ_a p[i, a] · yb[idx[i, a]]``).  Rows whose affinities are
+    all zero land at the origin.  Extracted so the graftfloor landmark
+    placement (``models/tsne.py``) reuses EXACTLY the serving init — one
+    implementation of the openTSNE interpolation recipe, not two."""
+    import jax.numpy as jnp
+    return jnp.einsum("bk,bkm->bm", p, yb[idx]).astype(yb.dtype)
+
+
 class _Stages:
     """The three compiled stage callables for one (model, bucket, iters)."""
 
@@ -140,8 +151,7 @@ def _build_stages(model, bucket: int, iters: int, eta: float) -> _Stages:
 
     def _init(dist, idx, yb):
         p = pairwise_affinities(dist, model.perplexity)
-        y0 = jnp.einsum("bk,bkm->bm", p, yb[idx])
-        return p, y0.astype(yb.dtype)
+        return p, interpolation_init(p, idx, yb)
 
     min_gain = TsneConfig().min_gain
     mom_switch = _momentum_switch(iters)

@@ -136,6 +136,34 @@ _declare("TSNE_AUTOPILOT", "bool", False,
          "(default) keeps the program bit-identical to the "
          "autopilot-free one. Mutually exclusive with "
          "TSNE_REPULSION_STRIDE > 1 — arm one policy, not both.")
+_declare("TSNE_FUSED_STEP", "str", "auto",
+         "graftfloor fused attraction+integration step "
+         "(ops/attraction_pallas.pick_fused_step): run the CSR-head "
+         "forces, the tail/repulsion combine and the vdM gains+momentum "
+         "update as ONE per-row-chunk kernel, vmapped across chunks, so "
+         "grad/gains/update never round-trip HBM. 'auto' (default) arms "
+         "it whenever the CSR attraction layout is armed; 'off' keeps "
+         "the optimize program byte-identical to the unfused (r12) "
+         "trace. Recorded on the bench policy block as 'fused_step'.",
+         choices=("auto", "on", "off"))
+_declare("TSNE_LANDMARK", "str", "auto",
+         "graftfloor landmark coarse-to-fine schedule "
+         "(models/autopilot.pick_landmark): optimize a seeded ~N/4 "
+         "subsample to convergence, place the remaining rows by "
+         "graftserve's affinity-interpolation init, then joint-polish "
+         "the final tail ('models/autopilot.landmark_schedule') on all "
+         "rows. 'auto' engages it only when the autopilot is armed and "
+         "N >= LANDMARK_MIN_N; 'off' keeps the full-N schedule "
+         "bit-identical to the pre-landmark program. Decision and "
+         "fractions ride the bench policy block. Honored by the bench "
+         "and tsne_embed/estimator drivers; the checkpointing CLI "
+         "always runs the plain schedule.",
+         choices=("auto", "on", "off"))
+_declare("TSNE_LANDMARK_FRACTION", "float", 0.25,
+         "Fraction of rows optimized as landmarks during the coarse "
+         "phase of the landmark schedule (seeded, sorted subsample). "
+         "The KL guardrail harness gates the schedule like every other "
+         "approximation (10k exact-oracle run, 0.05 tolerance).")
 
 # ---- runtime resilience (tsne_flink_tpu/runtime/) --------------------------
 _declare("TSNE_FAULT_PLAN", "str", None,
