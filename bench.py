@@ -606,10 +606,13 @@ def main():
         "repulsion_refreshes": pilot_mod.policy_report(
             cfg, None, iterations_run=0)["repulsion_refreshes"],
         "policy": pilot_mod.policy_report(cfg, None, iterations_run=0),
-        # graftserve (scripts/serve_bench.py): the out-of-sample serving
-        # block — {qps, p50_ms, p99_ms, model_id, n_queries, ...} when a
-        # serve sweep ran against this fit's frozen map, None for a pure
-        # batch bench (this script never serves)
+        # graftserve/graftsched (scripts/serve_bench.py): the out-of-
+        # sample serving block — {qps, p50_ms, p99_ms (interpolated, null
+        # below 20 requests), queue_ms_p50/compute_ms_p50 splits, sched,
+        # batch_fill_mean, model_id, n_queries, ...} when a serve sweep
+        # ran against this fit's frozen map, None for a pure batch bench
+        # (this script never serves; the scheduler A/B lands on
+        # serve_bench.py's serve_mixed block instead)
         "serve": None,
         # graftfloor satellite: per-term optimize cost split
         # ({attraction, repulsion, integration} s/iter — the post-run

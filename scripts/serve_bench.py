@@ -32,9 +32,12 @@ bare: ``python scripts/serve_bench.py --out results/serve_60k_cpu.json``.
 
 import argparse
 import json
+import math
 import os
 import sys
 import tempfile
+import threading
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -43,8 +46,14 @@ import numpy as np
 RECORD_BASE_KEYS = (
     "metric", "unit", "backend", "devices", "n", "d", "data", "data_seed",
     "fit_iters", "repulsion", "model_id", "aot_cache", "bucket", "iters",
-    "eta", "admission", "serve", "quality", "smoke",
+    "eta", "sched", "admission", "serve", "serve_mixed", "quality",
+    "smoke",
 )
+
+#: below this many requests a p99 claim is numerology, not measurement —
+#: the record carries ``p99_ms: null`` instead (graftsched's honesty fix
+#: for the PR-14 record's p50 == p99 artifact)
+MIN_REQUESTS_FOR_P99 = 20
 
 
 def _emit(rec: dict) -> None:
@@ -53,6 +62,63 @@ def _emit(rec: dict) -> None:
         raise AssertionError(f"serve record is missing {missing}; every "
                              "emission must spread the base dict")
     print(json.dumps(rec), flush=True)
+
+
+def _percentile(vals, q: float) -> float:
+    """Linear-interpolated percentile (the numpy 'linear' method, spelled
+    out) — unlike nearest-rank, distinct inputs give distinct p50/p99."""
+    if not len(vals):
+        return 0.0
+    s = sorted(float(v) for v in vals)
+    pos = q * (len(s) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    frac = pos - lo
+    return s[lo] * (1.0 - frac) + s[hi] * frac
+
+
+def _p50_ms(lat_s) -> float:
+    return round(_percentile(lat_s, 0.50) * 1e3, 3)
+
+
+def _p99_ms(lat_s):
+    """p99 in ms, or None below MIN_REQUESTS_FOR_P99 requests."""
+    if len(lat_s) < MIN_REQUESTS_FOR_P99:
+        return None
+    return round(_percentile(lat_s, 0.99) * 1e3, 3)
+
+
+def _split_p50(lats: list, key: str):
+    """p50 of a latency-record split (``queue_ms``/``compute_ms``), None
+    when the records do not carry it (scheduler-off drains)."""
+    vals = [r[key] for r in lats if key in r]
+    return round(_percentile(vals, 0.50), 3) if vals else None
+
+
+def _read_lats(spool: str, req_ids) -> list:
+    out = []
+    for rid in req_ids:
+        with open(os.path.join(spool, rid + ".lat.json"),
+                  encoding="utf-8") as f:
+            out.append(json.load(f))
+    return out
+
+
+def _mix_schedule(mix: str, total_rows: int, seed: int) -> list:
+    """Expand ``SIZE:WEIGHT,...`` into a seeded arrival order: whole
+    weight units repeated to cover ``total_rows``, then shuffled with
+    ``seed`` — deterministic, so the scheduler A/B sees the SAME
+    stream."""
+    pairs = []
+    for part in mix.split(","):
+        size, w = part.split(":")
+        pairs.append((int(size), int(w)))
+    unit = sum(s * w for s, w in pairs)
+    units = max(1, math.ceil(total_rows / unit))
+    sizes = [s for s, w in pairs for _ in range(w)] * units
+    rng = np.random.default_rng(seed)
+    rng.shuffle(sizes)
+    return [int(s) for s in sizes]
 
 
 def _knn_rows(y: np.ndarray, q: np.ndarray, k: int) -> np.ndarray:
@@ -91,17 +157,35 @@ def main(argv=None) -> int:
     p.add_argument("--sample", type=int, default=256,
                    help="base rows self-transformed for the quality pin")
     p.add_argument("--knn-k", type=int, default=10)
+    p.add_argument("--sched", default=None, choices=("on", "off"),
+                   help="scheduler mode for the headline/sweep drains "
+                   "(None = TSNE_SERVE_SCHED)")
+    p.add_argument("--mix", default=None,
+                   help="mixed-size workload 'SIZE:WEIGHT,...' (e.g. "
+                   "64:8,256:4,1024:1): one seeded arrival stream driven "
+                   "through a scheduler on/off A/B, client-observed "
+                   "latencies (submit -> result file), emitted as the "
+                   "serve_mixed block ('' / unset skips it)")
+    p.add_argument("--mix-rows", type=int, default=7680,
+                   help="total query rows of the mixed stream (rounded "
+                   "up to whole weight units)")
+    p.add_argument("--mix-seed", type=int, default=None,
+                   help="arrival-order shuffle seed (default "
+                   "DATA_SEED + 7)")
     p.add_argument("--out", default=None, help="also write the final "
                    "record to this JSON path (atomic)")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 shape: n=800, 128 queries, short fit")
     a = p.parse_args(argv)
     if a.smoke:
-        a.n, a.queries, a.request_rows = 800, 128, 32
+        # 4-row requests: 32 of them, enough for an honest p99 claim
+        a.n, a.queries, a.request_rows = 800, 128, 4
         a.fit_iters, a.sample = 150, 64  # past the exaggeration gate too
         a.bucket = a.bucket or 32
         a.iters = a.iters or 20
         a.sweep_rows = "16,64"
+        if a.mix is None:
+            a.mix, a.mix_rows = "16:4,64:1", 256
 
     import jax
 
@@ -140,7 +224,8 @@ def main(argv=None) -> int:
         "fit_iters": int(a.fit_iters), "repulsion": model.repulsion,
         "model_id": model.model_id, "aot_cache": aot.cache_label(),
         "bucket": bucket, "iters": iters, "eta": eta,
-        "admission": None, "serve": None, "quality": None,
+        "sched": None, "admission": None, "serve": None,
+        "serve_mixed": None, "quality": None,
         "smoke": bool(a.smoke),
     }
 
@@ -155,10 +240,12 @@ def main(argv=None) -> int:
     # ---- the serving drains: daemon over a temp spool --------------------
     def drain(request_rows: int):
         """All query rows at ``request_rows`` per request over a fresh
-        spool: (daemon summary, drain seconds, request count)."""
+        spool: (daemon summary, drain seconds, per-request latency
+        records)."""
         spool = tempfile.mkdtemp(prefix="tsne_serve_bench_")
         daemon = ServeDaemon(model, spool, bucket=bucket, iters=iters,
-                             eta=eta, tick_s=0.001)
+                             eta=eta, tick_s=0.001, sched=a.sched,
+                             idle_exit_s=0.05)
         req_ids = []
         for i in range(0, a.queries, request_rows):
             rid = f"q{i:06d}"
@@ -166,29 +253,40 @@ def main(argv=None) -> int:
             req_ids.append(rid)
         with obtrace.span("serve_bench.drain", cat="serve",
                           request_rows=request_rows) as sp:
-            daemon.serve_forever(max_ticks=len(req_ids) + 2)
+            daemon.serve_forever(max_ticks=len(req_ids) + 8)
         summary = daemon.summary()
         assert summary["served"] == len(req_ids), summary
         served = sum(read_result(spool, rid).shape[0] for rid in req_ids)
         assert served == a.queries, (served, a.queries)
-        return summary, sp.seconds, len(req_ids)
+        return summary, sp.seconds, _read_lats(spool, req_ids)
+
+    def _lat_stats(lats: list) -> dict:
+        """Interpolated percentiles over PER-REQUEST latencies, plus the
+        queue/compute splits the scheduler records — the fix for the
+        PR-14 record's degenerate p50 == p99."""
+        lat_s = [r["seconds"] for r in lats]
+        return {"p50_ms": _p50_ms(lat_s), "p99_ms": _p99_ms(lat_s),
+                "queue_ms_p50": _split_p50(lats, "queue_ms"),
+                "compute_ms_p50": _split_p50(lats, "compute_ms")}
 
     c0 = aot.compile_snapshot()
-    summary, drain_seconds, n_requests = drain(a.request_rows)
+    summary, drain_seconds, lats = drain(a.request_rows)
     sweep = []
     for rows in (int(s) for s in a.sweep_rows.split(",") if s):
-        s_sum, s_sec, s_req = drain(rows)
+        s_sum, s_sec, s_lats = drain(rows)
         sweep.append({"request_rows": rows,
                       "qps": round(a.queries / max(s_sec, 1e-9), 2),
-                      "p50_ms": s_sum["p50_ms"],
-                      "p99_ms": s_sum["p99_ms"], "n_requests": s_req})
+                      **_lat_stats(s_lats), "n_requests": len(s_lats)})
     c1 = aot.compile_snapshot()
+    base["sched"] = summary["sched"]
     base["admission"] = summary["admission"]
     base["serve"] = {
         "qps": round(a.queries / max(drain_seconds, 1e-9), 2),
-        "p50_ms": summary["p50_ms"], "p99_ms": summary["p99_ms"],
+        **_lat_stats(lats),
         "model_id": model.model_id, "n_queries": int(a.queries),
-        "n_requests": n_requests, "request_rows": int(a.request_rows),
+        "n_requests": len(lats), "request_rows": int(a.request_rows),
+        "sched": summary["sched"],
+        "batch_fill_mean": summary["batch_fill_mean"],
         "sweep": sweep,
         "drain_seconds": round(drain_seconds, 3),
         "warmup_seconds": round(sp_warm.seconds, 3),
@@ -198,6 +296,84 @@ def main(argv=None) -> int:
         # first request arrived
         "compile_seconds": round(c1["seconds"] - c0["seconds"], 3),
     }
+
+    # ---- mixed-size workload: the scheduler's A/B ------------------------
+    def drain_mixed(sizes: list, sched_mode: str) -> dict:
+        """One seeded mixed-size stream, client-observed latencies: the
+        daemon serves on a background thread while this thread submits
+        the burst and watches result files land."""
+        total = int(sum(sizes))
+        rng_m = np.random.default_rng(DATA_SEED + 3)
+        pool = (x[rng_m.integers(0, a.n, total)]
+                + 0.05 * rng_m.standard_normal((total, x.shape[1]))
+                ).astype(x.dtype)
+        spool = tempfile.mkdtemp(prefix="tsne_serve_mixed_")
+        daemon = ServeDaemon(model, spool, bucket=bucket, iters=iters,
+                             eta=eta, tick_s=0.001, sched=sched_mode,
+                             idle_exit_s=0.75)
+        th = threading.Thread(target=daemon.serve_forever, daemon=True)
+        th.start()
+        submit_t, done_t, off = {}, {}, 0
+        for i, rows in enumerate(sizes):
+            rid = f"m{i:06d}"
+            submit(spool, pool[off:off + rows], rid)
+            submit_t[rid] = obtrace.walltime()
+            off += rows
+        pending = set(submit_t)
+        hard_stop = obtrace.walltime() + 1800.0
+        while pending and obtrace.walltime() < hard_stop:
+            for rid in sorted(pending):
+                if os.path.exists(os.path.join(spool, rid + ".res.npz")):
+                    done_t[rid] = obtrace.walltime()
+                    pending.discard(rid)
+            time.sleep(0.002)
+        th.join(timeout=60.0)
+        assert not pending, (f"mixed drain ({sched_mode}) timed out with "
+                             f"{len(pending)} requests pending")
+        lats = _read_lats(spool, sorted(submit_t))
+        cls: dict = {}
+        for i, rows in enumerate(sizes):
+            rid = f"m{i:06d}"
+            cls.setdefault(rows, []).append(done_t[rid] - submit_t[rid])
+        by_rid = {r["req"]: r for r in lats}
+        classes = {}
+        for rows in sorted(cls):
+            rids = [f"m{i:06d}" for i, s in enumerate(sizes) if s == rows]
+            classes[str(rows)] = {
+                "n_requests": len(cls[rows]),
+                "p50_ms": _p50_ms(cls[rows]), "p99_ms": _p99_ms(cls[rows]),
+                "queue_ms_p50": _split_p50(
+                    [by_rid[r] for r in rids], "queue_ms"),
+                "compute_ms_p50": _split_p50(
+                    [by_rid[r] for r in rids], "compute_ms")}
+        all_lat = [done_t[r] - submit_t[r] for r in submit_t]
+        seconds = max(done_t.values()) - min(submit_t.values())
+        summary = daemon.summary()
+        return {"sched": sched_mode, "n_requests": len(all_lat),
+                "rows": total,
+                "qps": round(total / max(seconds, 1e-9), 2),
+                "p50_ms": _p50_ms(all_lat), "p99_ms": _p99_ms(all_lat),
+                "classes": classes,
+                "drain_seconds": round(seconds, 3),
+                "batches": summary["batches"],
+                "batch_fill_mean": summary["batch_fill_mean"],
+                "promotions": summary["promotions"]}
+
+    if a.mix:
+        seed = (int(a.mix_seed) if a.mix_seed is not None
+                else DATA_SEED + 7)
+        sizes = _mix_schedule(a.mix, a.mix_rows, seed)
+        cm0 = aot.compile_snapshot()
+        block_on = drain_mixed(sizes, "on")
+        block_off = drain_mixed(sizes, "off")
+        cm1 = aot.compile_snapshot()
+        base["serve_mixed"] = {
+            "mix": a.mix, "rows": int(sum(sizes)),
+            "schedule_seed": seed,
+            "sched_on": block_on, "sched_off": block_off,
+            # both mixed drains ride the SAME warm executables
+            "compile_seconds": round(cm1["seconds"] - cm0["seconds"], 3),
+        }
 
     # ---- quality pin: self-transform of a base-row sample ----------------
     sample = rng.choice(a.n, size=min(a.sample, a.n), replace=False)

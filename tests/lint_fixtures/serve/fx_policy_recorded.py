@@ -1,0 +1,53 @@
+"""Seeded policy-recorded violations for serve/ (exercised by
+tests/test_lint.py).
+
+graftsched's observability bar: ``pick_*`` resolvers in serve/ must
+name, in double backticks, the record key their resolved choice lands
+in — a key of serve_bench.py's ``RECORD_BASE_KEYS`` OR of sched.py's
+``SCHED_RECORD_KEYS`` (the per-request latency record) — or carry a
+rationale'd suppression.  Stamped resolvers (either keyset), non-
+``pick_`` helpers and suppressed twins must stay silent.
+"""
+
+
+def pick_mystery_lane(rows):  # VIOLATION: no docstring at all
+    return "express" if rows <= 256 else "bulk"
+
+
+def pick_undocumented_deadline(load):  # VIOLATION: names no record key
+    """Adaptive deadline: halve the budget when the queue runs hot."""
+    return 25.0 if load > 0.8 else 50.0
+
+
+def pick_fake_stamped(n):  # VIOLATION: ``not_a_record_key`` is not a key
+    """Resolves the coalescing horizon; recorded as ``not_a_record_key``."""
+    return n % 3
+
+
+def pick_sched_key_stamped(rows, bucket):
+    """Lane policy; the resolved lane rides every per-request latency
+    record as ``lane``."""
+    return "express" if rows <= bucket else "bulk"
+
+
+def pick_bench_key_stamped(mode):
+    """Scheduler mode policy; what actually ran is recorded as ``sched``
+    on the serve bench record."""
+    return mode or "on"
+
+
+def pick_base_key_stamped(n):
+    """Falls back to the training-side record: the choice lands as
+    ``knn_method`` (bench keys remain valid in serve/ too)."""
+    return "bruteforce" if n < 100_000 else "project"
+
+
+def helper_not_a_policy(rows):
+    # not pick_*-named: out of scope, silent
+    return rows * 2
+
+
+# graftlint: disable=policy-recorded -- seeded suppression twin: output is
+# a pure function of rows, which the latency record pins
+def pick_suppressed(rows):
+    return rows // 2

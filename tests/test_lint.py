@@ -96,6 +96,20 @@ def test_rule_fires_exactly_at_seeded_violations(rule, fixture):
                              f"violations at {sorted(expected)}")
 
 
+def test_policy_recorded_fires_in_serve_fixture():
+    """graftsched extension: policy-recorded also scans serve/, where a
+    resolver may stamp a serve_bench RECORD_BASE_KEYS key OR a sched.py
+    SCHED_RECORD_KEYS latency-record key (and bench keys stay valid)."""
+    fixture = os.path.join("serve", "fx_policy_recorded.py")
+    findings = run_rule("policy-recorded", fixture)
+    assert findings, "policy-recorded found nothing in the serve fixture"
+    assert {f.rule for f in findings} == {"policy-recorded"}
+    got = {f.line for f in findings}
+    expected = violation_lines(fixture)
+    assert got == expected, (f"findings at {sorted(got)}, seeded "
+                             f"violations at {sorted(expected)}")
+
+
 def test_suppression_comment_silences(tmp_path):
     src = ("import os\n"
            "A = os.environ.get('TSNE_FORCE_CPU', '')\n"

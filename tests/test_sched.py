@@ -1,0 +1,482 @@
+"""graftsched (ISSUE 17): the deadline-driven micro-batch scheduler,
+multi-model residency and hot-swap.
+
+Acceptance contracts, all CPU-only:
+
+* MicroBatcher packing is deterministic — (promoted, lane, deadline,
+  claim seq) order, express ahead of bulk, one model per batch, starved
+  bulk promoted — and work-conserving (``ready`` fires on an idle
+  device);
+* scheduled serving is BIT-IDENTICAL to direct per-request transforms
+  for any request-size mix (per-row independence makes packing inert),
+  with every scheduling decision on the per-request latency record;
+* chaos: ``kill@serve:seg1`` SIGKILLs the daemon mid-tick with a
+  partially dispatched multi-request batch in flight; the restarted
+  daemon breaks the orphaned claim locks and re-serves every unfinished
+  request bit-identically — results only ever land whole;
+* hot-swap under load answers zero stale responses: a request binds its
+  model at CLAIM, so each response's ``model_id`` names exactly the
+  model active (or pinned) when it was claimed;
+* residency admission refuses an over-budget second model, leaves the
+  resident set unchanged, and records the refusal;
+* the ``<name>.swap.json`` control file drives the same load+activate
+  from another process, answered by ``<name>.swap.done.json`` (errors
+  land in the done file, never take the serving loop down);
+* the serve-bench helpers behind the committed mixed record: linear
+  interpolated percentiles (p50 != p99 on distinct inputs), the p99
+  honesty floor, and the seeded ``--mix`` arrival stream.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+from tsne_flink_tpu.models.tsne import TsneState
+from tsne_flink_tpu.runtime.admission import ADMIT, QUEUE, decide_residency
+from tsne_flink_tpu.runtime.fleet import ServeSpec
+from tsne_flink_tpu.serve.daemon import (SWAP_DONE_SUFFIX, SWAP_SUFFIX,
+                                         ServeDaemon, read_result, submit)
+from tsne_flink_tpu.serve.model import from_arrays
+from tsne_flink_tpu.serve.sched import (BULK, EXPRESS, MicroBatcher,
+                                        Request)
+from tsne_flink_tpu.serve.transform import transform
+from tsne_flink_tpu.utils import checkpoint as ckpt
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+D, M = 6, 2
+
+
+def _model(n=96, d=D, seed=0, name="sched-test"):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((n, M))).astype(np.float32)
+    plan = PlanConfig(n=n, d=d, k=12, backend="cpu", repulsion="exact",
+                      name=name)
+    return from_arrays(x, y, plan, perplexity=4.0, learning_rate=100.0)
+
+
+def _queries(rows, d=D, seed=9):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((rows, d)).astype(np.float32)
+
+
+# ---- MicroBatcher: the packing state machine --------------------------------
+
+class _NoLock:
+    def release(self):
+        pass
+
+
+def _req(mb, rid, rows, *, arrival, model_id="m", bucket=16,
+         deadline_s=0.05):
+    return Request(rid, rid + ".req.npz", _NoLock(),
+                   np.zeros((rows, 3), np.float32), model_id,
+                   arrival=arrival, deadline_s=deadline_s,
+                   seq=mb.next_seq(), bucket=bucket, out_width=M,
+                   out_dtype=np.float32, poll_ms=1.0)
+
+
+def _pack_all(mb, now):
+    packs = []
+    while True:
+        b = mb.next_batch(now)
+        if b is None:
+            break
+        packs.append([(r.rid, start, take, off)
+                      for r, start, take, off in b.parts])
+    return packs
+
+
+def test_microbatcher_express_packs_ahead_and_is_deterministic():
+    """A 40-row bulk request claimed FIRST still yields the bucket to the
+    8-row express request behind it; re-running the same claim stream
+    re-packs identically (pure function of claim order + clock)."""
+    runs = []
+    for _ in range(2):
+        mb = MicroBatcher(16, deadline_s=0.05, starve_s=10.0)
+        bulk = _req(mb, "big", 40, arrival=0.0)
+        small = _req(mb, "tiny", 8, arrival=0.001)
+        assert bulk.lane == BULK and small.lane == EXPRESS
+        mb.add(bulk)
+        mb.add(small)
+        assert mb.pending_rows() == 48
+        runs.append(_pack_all(mb, now=0.002))
+        assert mb.pending == [] and mb.pending_rows() == 0
+    assert runs[0] == runs[1]
+    first = runs[0][0]
+    assert first[0][:3] == ("tiny", 0, 8)   # express rides the first bucket
+    assert first[1][:3] == ("big", 0, 8)    # bulk fills its padding
+    assert [sum(t for _, _, t, _ in p) for p in runs[0]] == [16, 16, 16]
+
+
+def test_microbatcher_ready_is_work_conserving():
+    mb = MicroBatcher(16, deadline_s=0.05, starve_s=10.0)
+    assert not mb.ready(0.0, device_idle=True)   # nothing pending
+    mb.add(_req(mb, "a", 4, arrival=0.0))
+    assert mb.ready(0.0, device_idle=True)       # idle device: dispatch now
+    assert not mb.ready(0.01, device_idle=False)  # busy, before deadline
+    assert mb.ready(0.051, device_idle=False)    # deadline arrived
+    mb.add(_req(mb, "b", 12, arrival=0.01))
+    assert mb.ready(0.011, device_idle=False)    # a bucket can fill
+    # service-proportional slack: 4 rows in a 16-bucket carries a
+    # quarter of the deadline unit
+    assert mb.earliest_deadline() == pytest.approx(0.05 * 4 / 16)
+
+
+def test_microbatcher_deadlines_are_service_proportional():
+    """Slack scales with the work a request carries, so the EDF drain
+    packs a small request ahead of a same-instant bigger one even when
+    the bigger one was claimed first — under a burst the express lane
+    does not degenerate to FIFO."""
+    mb = MicroBatcher(16, deadline_s=0.05, starve_s=10.0)
+    mid = _req(mb, "mid", 16, arrival=0.0)     # claimed first
+    small = _req(mb, "small", 4, arrival=0.0)  # same instant, less work
+    assert mid.lane == EXPRESS and small.lane == EXPRESS
+    assert small.deadline < mid.deadline
+    mb.add(mid)
+    mb.add(small)
+    batch = mb.next_batch(now=0.0)
+    assert batch.parts[0][0].rid == "small"
+    # ...but a fresh small request never preempts sufficiently old work:
+    # deadlines grow with arrival, so EDF stays starvation-free.
+    mb2 = MicroBatcher(16, deadline_s=0.05, starve_s=10.0)
+    old_big = _req(mb2, "old", 16, arrival=0.0)
+    fresh = _req(mb2, "fresh", 4, arrival=1.0)
+    assert old_big.deadline < fresh.deadline
+    mb2.add(old_big)
+    mb2.add(fresh)
+    assert mb2.next_batch(now=1.0).parts[0][0].rid == "old"
+
+
+def test_microbatcher_starved_bulk_promotes_ahead_of_express():
+    mb = MicroBatcher(16, deadline_s=0.05, starve_s=0.5)
+    bulk = _req(mb, "big", 32, arrival=0.0)
+    small = _req(mb, "tiny", 4, arrival=1.0)
+    mb.add(bulk)
+    mb.add(small)
+    batch = mb.next_batch(now=1.0)  # bulk has waited 1.0 s > starve_s
+    assert bulk.promoted and mb.promotions == 1
+    assert batch.parts[0][0].rid == "big"
+    # without starvation the express request would have led the bucket
+    mb2 = MicroBatcher(16, deadline_s=0.05, starve_s=10.0)
+    b2, s2 = _req(mb2, "big", 32, arrival=0.0), _req(mb2, "tiny", 4,
+                                                     arrival=1.0)
+    mb2.add(b2)
+    mb2.add(s2)
+    assert mb2.next_batch(now=1.0).parts[0][0].rid == "tiny"
+    assert not b2.promoted and mb2.promotions == 0
+
+
+def test_microbatcher_one_model_per_batch():
+    """The AOT executables are model-keyed, so a batch never mixes
+    models: same-model requests pack around a foreign one."""
+    mb = MicroBatcher(16, deadline_s=0.05, starve_s=10.0)
+    a1 = _req(mb, "a1", 8, arrival=0.0, model_id="A")
+    b1 = _req(mb, "b1", 8, arrival=0.001, model_id="B")
+    a2 = _req(mb, "a2", 8, arrival=0.002, model_id="A")
+    for r in (a1, b1, a2):
+        mb.add(r)
+    first = mb.next_batch(now=0.003)
+    assert first.model_id == "A" and first.rows == 16
+    assert [p[0].rid for p in first.parts] == ["a1", "a2"]
+    second = mb.next_batch(now=0.003)
+    assert second.model_id == "B" and second.rows == 8
+    assert second.fill == pytest.approx(0.5)
+
+
+# ---- scheduled serving: bit-identity + the latency record -------------------
+
+def test_sched_daemon_mixed_sizes_bitidentical_with_sliced_bulk(tmp_path):
+    """A 40-row bulk request (3 bucket slices), a 5-row express and an
+    exactly-bucket request serve bit-identically to direct transforms,
+    and every scheduling decision lands on the latency record."""
+    model = _model()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    qs = {"big": _queries(40, seed=1), "tiny": _queries(5, seed=2),
+          "full": _queries(16, seed=3)}
+    for rid, q in qs.items():
+        submit(spool, q, rid)
+    d = ServeDaemon(model, spool, bucket=16, iters=8, tick_s=0.001,
+                    sched="on", idle_exit_s=0.05)
+    summary = d.serve_forever(max_ticks=50)
+    assert summary["served"] == 3 and summary["sched"] == "on"
+    assert summary["batches"] >= 3 and summary["batch_fill_mean"] > 0
+    for rid, q in qs.items():
+        np.testing.assert_array_equal(
+            read_result(spool, rid),
+            transform(model, q, bucket=16, iters=8))
+    with open(os.path.join(spool, "big.lat.json")) as f:
+        big = json.load(f)
+    assert big["lane"] == BULK and big["slices"] == 3
+    assert big["sched"] == "on" and big["model_id"] == model.model_id
+    for key in ("queue_ms", "compute_ms", "write_ms", "batch_fill",
+                "deadline_ms", "starve_ms", "poll_ms", "promoted"):
+        assert key in big, f"latency record dropped {key}"
+    with open(os.path.join(spool, "tiny.lat.json")) as f:
+        assert json.load(f)["lane"] == EXPRESS
+    # clean spool: results + latency records only
+    assert sorted(os.listdir(spool)) == sorted(
+        [f"{r}.lat.json" for r in qs] + [f"{r}.res.npz" for r in qs])
+
+
+def test_sched_off_matches_pr14_serial_lat_schema(tmp_path):
+    """TSNE_SERVE_SCHED=off is the PR-14 drain: no scheduler fields leak
+    into the latency record (the A/B comparison stays honest)."""
+    model = _model()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    submit(spool, _queries(10, seed=4), "r0")
+    d = ServeDaemon(model, spool, bucket=16, iters=8, tick_s=0.001,
+                    sched="off")
+    assert d.serve_forever(max_ticks=3)["served"] == 1
+    with open(os.path.join(spool, "r0.lat.json")) as f:
+        lat = json.load(f)
+    assert "queue_ms" not in lat and "lane" not in lat
+    assert lat["model_id"] == model.model_id
+
+
+# ---- residency + hot-swap ---------------------------------------------------
+
+def test_decide_residency_sums_against_budget():
+    assert decide_residency({"a": 100}, "b", 50, None).action == ADMIT
+    assert decide_residency({"a": 100}, "b", 50, 150).action == ADMIT
+    got = decide_residency({"a": 100}, "b", 51, 150)
+    assert got.action == QUEUE and "refused" in got.reason
+    assert got.predicted_peak == 151
+
+
+def test_admission_rejects_over_budget_second_model(tmp_path):
+    """The fleet-budget sum refuses model B, leaves the resident set
+    unchanged, and the refusal is recorded on the residency events."""
+    a, b = _model(seed=0, name="res-a"), _model(seed=1, name="res-b")
+    assert a.model_id != b.model_id
+    peak = a.transform_peak(8)
+    d = ServeDaemon(a, str(tmp_path), bucket=8, iters=2, sched="on",
+                    budget_bytes=int(1.5 * peak))
+    event = d.load_model(b)
+    assert event["action"] == QUEUE and "refused" in event["reason"]
+    assert b.model_id not in d.models and d.active_id == a.model_id
+    res = d.summary()["residency"]
+    assert res["resident"] == [a.model_id]
+    assert any(e["op"] == "load" and e["action"] == QUEUE
+               for e in res["events"])
+    with pytest.raises(KeyError, match="not resident"):
+        d.activate(b.model_id)
+
+
+def test_hot_swap_under_load_zero_stale_responses(tmp_path):
+    """Swap the active model while requests flow: every response's
+    ``model_id`` names the model bound at ITS claim — the pre-swap
+    request answers with A, the post-swap one with B, and a request
+    pinned to A still answers with A after the swap."""
+    a, b = _model(seed=0, name="swap-a"), _model(seed=1, name="swap-b")
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    d = ServeDaemon(a, spool, bucket=16, iters=6, tick_s=0.001,
+                    sched="on", idle_exit_s=0.05)
+    q1, q2, q3 = (_queries(10, seed=1), _queries(10, seed=2),
+                  _queries(10, seed=3))
+    submit(spool, q1, "r1")
+    d.serve_forever(max_ticks=20)
+    assert d.load_model(b, activate=True)["action"] == ADMIT
+    assert d.active_id == b.model_id and d._swaps == 1
+    submit(spool, q2, "r2")                      # binds active B at claim
+    submit(spool, q3, "r3", model_id=a.model_id)  # pinned to resident A
+    d.serve_forever(max_ticks=20)
+    assert d.served == 3
+    lat = {}
+    for rid in ("r1", "r2", "r3"):
+        with open(os.path.join(spool, rid + ".lat.json")) as f:
+            lat[rid] = json.load(f)["model_id"]
+    assert lat == {"r1": a.model_id, "r2": b.model_id, "r3": a.model_id}
+    np.testing.assert_array_equal(read_result(spool, "r1"),
+                                  transform(a, q1, bucket=16, iters=6))
+    np.testing.assert_array_equal(read_result(spool, "r2"),
+                                  transform(b, q2, bucket=16, iters=6))
+    np.testing.assert_array_equal(read_result(spool, "r3"),
+                                  transform(a, q3, bucket=16, iters=6))
+    res = d.summary()["residency"]
+    assert sorted(res["resident"]) == sorted([a.model_id, b.model_id])
+    assert res["active"] == b.model_id
+    assert res["report"]["models"] and res["report"]["peak_bytes"] > 0
+
+
+def test_unknown_pinned_model_gets_err_file_not_a_hang(tmp_path):
+    model = _model()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    submit(spool, _queries(4, seed=5), "bad", model_id="nonexistent")
+    d = ServeDaemon(model, spool, bucket=16, iters=4, tick_s=0.001,
+                    sched="on", idle_exit_s=0.05)
+    summary = d.serve_forever(max_ticks=10)
+    assert summary["served"] == 0 and summary["failed"] == 1
+    with open(os.path.join(spool, "bad.err.json")) as f:
+        err = json.load(f)
+    assert "not resident" in err["error"]
+    assert not os.path.exists(os.path.join(spool, "bad.req.npz"))
+
+
+# ---- swap control files -----------------------------------------------------
+
+def _save_ckpt_fixture(tmp_path, n=64, d=D, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((n, M))).astype(np.float32)
+    st = TsneState(y=jnp.asarray(y),
+                   update=jnp.zeros_like(jnp.asarray(y)),
+                   gains=jnp.ones_like(jnp.asarray(y)))
+    model_path = os.path.join(str(tmp_path), f"model{seed}.npz")
+    ckpt.save(model_path, st, 10, np.asarray([0.5]))
+    input_path = os.path.join(str(tmp_path), f"x{seed}.npy")
+    np.save(input_path, x)
+    return x, model_path, input_path
+
+
+def test_swap_control_file_roundtrip_and_error_isolation(tmp_path):
+    """A ``<name>.swap.json`` in the spool loads + activates the named
+    model before the same tick's claims (requests after it answer with
+    the new model); a broken control file lands its error in the done
+    file and serving continues."""
+    _, model_path, input_path = _save_ckpt_fixture(tmp_path)
+    base = _model()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    ctl = {"model": model_path, "input": input_path, "perplexity": 4.0,
+           "learning_rate": 100.0, "neighbors": 8, "repulsion": "exact",
+           "activate": True}
+    with open(os.path.join(spool, "m2" + SWAP_SUFFIX), "w") as f:
+        json.dump(ctl, f)
+    with open(os.path.join(spool, "broken" + SWAP_SUFFIX), "w") as f:
+        json.dump({"model": "/nonexistent.npz", "input": input_path}, f)
+    q = _queries(7, seed=6)
+    submit(spool, q, "r0")
+    d = ServeDaemon(base, spool, bucket=16, iters=4, tick_s=0.001,
+                    sched="on", idle_exit_s=0.05)
+    summary = d.serve_forever(max_ticks=20)
+    assert summary["served"] == 1 and d._swaps == 1
+    with open(os.path.join(spool, "m2" + SWAP_DONE_SUFFIX)) as f:
+        done = json.load(f)
+    assert done["status"] == "ok" and done["action"] == ADMIT
+    with open(os.path.join(spool, "broken" + SWAP_DONE_SUFFIX)) as f:
+        broken = json.load(f)
+    assert broken["status"] == "error" and broken["error"]
+    new_id = done["model_id"]
+    assert d.active_id == new_id != base.model_id
+    with open(os.path.join(spool, "r0.lat.json")) as f:
+        assert json.load(f)["model_id"] == new_id
+    np.testing.assert_array_equal(
+        read_result(spool, "r0"),
+        transform(d.models[new_id], q, bucket=16, iters=4))
+    assert not os.path.exists(os.path.join(spool, "m2" + SWAP_SUFFIX))
+
+
+# ---- chaos: SIGKILL mid-tick, partially dispatched batch --------------------
+
+def test_sched_chaos_kill_mid_batch_then_bitidentical_reserve(tmp_path):
+    """``kill@serve:seg1`` SIGKILLs the scheduled daemon after r2 (the
+    tightest service-proportional deadline) landed and r0's result is
+    about to write — request 1 is PARTIALLY dispatched (15 of 23 rows
+    computed, none written).  The restarted daemon stale-breaks both
+    orphaned claim locks and re-serves r0 and r1 bit-identically to
+    direct transforms: results only ever land whole, in any packing."""
+    x, model_path, input_path = _save_ckpt_fixture(tmp_path)
+    spool = os.path.join(str(tmp_path), "spool")
+    os.makedirs(spool)
+    qs = {"r0": _queries(10, seed=4), "r1": _queries(23, seed=5),
+          "r2": _queries(7, seed=6)}
+    for rid, q in qs.items():
+        submit(spool, q, rid)
+    record_path = os.path.join(str(tmp_path), "serve_record.json")
+    spec = ServeSpec(name="sched-chaos", model=model_path,
+                     input=input_path, spool=spool, record=record_path,
+                     perplexity=4.0, learning_rate=100.0, neighbors=8,
+                     repulsion="exact", bucket=16, iters=6, max_ticks=30,
+                     sched="on", fault_plan="kill@serve:seg1")
+    spec_path = spec.save(os.path.join(str(tmp_path), "serve.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TSNE_ARTIFACTS="0",
+               TSNE_AOT_CACHE="0", TSNE_SERVE_TICK_S="0.01",
+               TSNE_LOCK_STALE_S="0.05")
+    cmd = [sys.executable, "-m", "tsne_flink_tpu.runtime.fleet",
+           "--serve", spec_path]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=300)
+    assert r.returncode == -9, (r.returncode, r.stderr[-2000:])
+    # r2 landed (seg0 — tightest deadline packs first); r0 + r1 hold
+    # orphaned claims, requests intact
+    assert read_result(spool, "r2") is not None
+    for rid in ("r0", "r1"):
+        assert read_result(spool, rid) is None
+        assert os.path.exists(os.path.join(spool, rid + ".req.npz"))
+        assert os.path.exists(os.path.join(spool,
+                                           rid + ".req.npz.lock"))
+
+    time.sleep(0.1)  # age the orphaned claims past TSNE_LOCK_STALE_S
+    spec.fault_plan = None
+    spec.save(spec_path)
+    r2 = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                        cwd=REPO, timeout=300)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    from tsne_flink_tpu.serve.model import load_frozen
+    plan = PlanConfig(n=64, d=D, k=8, backend="cpu", repulsion="exact",
+                      name="sched-chaos-direct")
+    model = load_frozen(model_path, x, plan, perplexity=4.0,
+                        learning_rate=100.0)
+    for rid, q in qs.items():
+        np.testing.assert_array_equal(
+            read_result(spool, rid),
+            transform(model, q, bucket=16, iters=6))
+    litter = [n for n in os.listdir(spool)
+              if not (n.endswith(".res.npz") or n.endswith(".lat.json"))]
+    assert litter == []
+    with open(record_path) as f:
+        rec = json.load(f)
+    assert rec["status"] == "ok" and rec["served"] == 2
+    assert rec["sched"] == "on"
+
+
+# ---- serve-bench helpers behind the committed mixed record ------------------
+
+def _serve_bench():
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(REPO, "scripts", "serve_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_bench_percentiles_interpolate_and_p99_honesty():
+    sb = _serve_bench()
+    vals = [float(v) for v in range(1, 101)]
+    assert sb._percentile(vals, 0.50) == pytest.approx(50.5)
+    assert sb._percentile(vals, 0.99) == pytest.approx(99.01)
+    assert sb._percentile([], 0.5) == 0.0
+    # distinct inputs give distinct p50/p99 — the PR-14 record's
+    # p50 == p99 artifact (nearest-rank over coalesced ticks) is gone
+    assert sb._percentile(vals, 0.99) != sb._percentile(vals, 0.50)
+    assert sb._p99_ms([0.001] * (sb.MIN_REQUESTS_FOR_P99 - 1)) is None
+    assert sb._p99_ms([0.001] * sb.MIN_REQUESTS_FOR_P99) is not None
+    lats = [{"queue_ms": 1.0, "compute_ms": 2.0},
+            {"queue_ms": 3.0, "compute_ms": 4.0}]
+    assert sb._split_p50(lats, "queue_ms") == pytest.approx(2.0)
+    assert sb._split_p50([{"seconds": 1.0}], "queue_ms") is None
+
+
+def test_serve_bench_mix_schedule_is_seeded_and_weighted():
+    sb = _serve_bench()
+    sched = sb._mix_schedule("64:8,256:4,1024:1", 7680, seed=7)
+    assert sum(sched) >= 7680
+    counts = {s: sched.count(s) for s in (64, 256, 1024)}
+    assert counts == {64: 24, 256: 12, 1024: 3}  # 3 whole weight units
+    assert sched == sb._mix_schedule("64:8,256:4,1024:1", 7680, seed=7)
+    assert sched != sorted(sched)  # shuffled arrival order, not sorted
+    assert sb._mix_schedule("64:8,256:4,1024:1", 7680, seed=8) != sched

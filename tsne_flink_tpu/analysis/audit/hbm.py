@@ -336,6 +336,33 @@ def transform_peak_bytes(plan: PlanConfig) -> int:
     return int(_transform_stage(plan)["peak"])
 
 
+def residency_report(plans) -> dict:
+    """graftsched: the multi-model resident-set term of a serve daemon
+    holding several FrozenModels at once.  Model arrays (the ``model``
+    term of every transform stage) are resident SIMULTANEOUSLY for the
+    process lifetime; the per-bucket transients (knn tile, query working
+    set, attraction/repulsion tiles) exist only for in-flight batches,
+    and the double-buffered pipelined tick holds at most TWO of those —
+    so the refined peak is
+
+        sum(model terms) + 2 * max(per-bucket transient terms).
+
+    The daemon's admission gate deliberately charges the cruder
+    ``sum(transform_peak_bytes)`` instead (every model billed its own
+    transients — see ``runtime/admission.decide_residency``); this
+    report carries both so a reader of the summary can see the slack."""
+    stages = [_transform_stage(p) for p in plans]
+    resident = float(sum(s["model"] for s in stages))
+    transient = max((float(s["peak"]) - float(s["model"])
+                     for s in stages), default=0.0)
+    return {"models": len(stages),
+            "resident_bytes": int(resident),
+            "transient_bytes": int(transient),
+            "peak_bytes": int(resident + 2.0 * transient),
+            "conservative_sum_bytes": int(sum(float(s["peak"])
+                                              for s in stages))}
+
+
 def plan_hbm_report(plan: PlanConfig) -> dict:
     """Per-stage peak-HBM estimates + the plan-level verdict."""
     stages = {"knn": _knn_stage(plan), "affinities": _affinity_stage(plan),

@@ -69,7 +69,7 @@ import ast
 import os
 import re
 
-from tsne_flink_tpu.analysis.core import Finding, Project, rule
+from tsne_flink_tpu.analysis.core import Finding, Module, Project, rule
 
 ENV_NAME_RE = re.compile(r"TSNE_[A-Z0-9_]+\Z")
 ENV_PREFIX = "TSNE_"
@@ -1328,7 +1328,47 @@ _RECORD_KEYS_FALLBACK = (
 _CONTEXT_KEYS = ("metric", "unit", "backend", "devices", "n", "iterations",
                  "theta", "data", "data_seed")
 
+#: frozen copy of the SERVE-side record keys — scripts/serve_bench.py's
+#: ``RECORD_BASE_KEYS`` plus serve/sched.py's ``SCHED_RECORD_KEYS`` (the
+#: per-request latency-record fields) — for invocations that do not scan
+#: those files.  Same no-silent-drift property as _RECORD_KEYS_FALLBACK:
+#: on a whole-repo run the live tuples win.
+_SERVE_KEYS_FALLBACK = (
+    # serve_bench.py RECORD_BASE_KEYS (minus pure workload context)
+    "fit_iters", "model_id", "aot_cache", "bucket", "iters", "eta",
+    "sched", "admission", "serve", "serve_mixed", "quality", "smoke",
+    # serve/sched.py SCHED_RECORD_KEYS (latency-record fields)
+    "deadline_ms", "starve_ms", "poll_ms", "queue_ms", "compute_ms",
+    "write_ms", "batch_fill", "lane", "slices", "spool", "promoted",
+    "batches", "residency", "seconds",
+)
+
 _BACKTICK_KEY_RE = re.compile(r"``([A-Za-z0-9_]+)``")
+
+
+def _module_named(project: Project, filename: str) -> Module | None:
+    """The scanned module whose display path IS ``filename`` or ends in
+    ``/filename`` as a whole path segment — unlike
+    ``Project.module_with_suffix``, ``"bench.py"`` does NOT match
+    ``scripts/serve_bench.py``."""
+    for mod in project.modules:
+        norm = mod.display.replace(os.sep, "/")
+        if norm == filename or norm.endswith("/" + filename):
+            return mod
+    return None
+
+
+def _live_tuple(mod: Module, name: str) -> set[str] | None:
+    """A top-level ``NAME = (...)`` tuple/list of strings in ``mod``, or
+    None when absent/not-literal."""
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)):
+            val = _literal(node.value)
+            if isinstance(val, (tuple, list)):
+                return set(val)
+    return None
 
 
 def _bench_record_keys(project: Project) -> set[str]:
@@ -1337,23 +1377,36 @@ def _bench_record_keys(project: Project) -> set[str]:
     fallback), plus the final record's extra keys, minus the pure
     workload-context keys."""
     keys = None
-    mod = project.module_with_suffix("bench.py")
+    mod = _module_named(project, "bench.py")
     if mod is not None:
-        for node in mod.tree.body:
-            if (isinstance(node, ast.Assign)
-                    and any(isinstance(t, ast.Name)
-                            and t.id == "RECORD_BASE_KEYS"
-                            for t in node.targets)):
-                val = _literal(node.value)
-                if isinstance(val, (tuple, list)):
-                    keys = set(val)
+        keys = _live_tuple(mod, "RECORD_BASE_KEYS")
     if keys is None:
         keys = set(_RECORD_KEYS_FALLBACK)
     return (keys | set(EXTRA_RECORD_KEYS)) - set(_CONTEXT_KEYS)
 
 
+def _serve_record_keys(project: Project) -> set[str]:
+    """The record keys a SERVE-side resolver may stamp: the live union of
+    scripts/serve_bench.py's ``RECORD_BASE_KEYS`` (the bench record) and
+    serve/sched.py's ``SCHED_RECORD_KEYS`` (the per-request ``.lat.json``
+    latency record) when scanned, else the frozen fallback — minus the
+    workload-context keys.  A scheduling knob read in serve/ counts as
+    recorded if it lands on EITHER record: the bench record pins the run,
+    the latency record pins each request."""
+    keys: set[str] = set()
+    mod = _module_named(project, "serve_bench.py")
+    if mod is not None:
+        keys |= _live_tuple(mod, "RECORD_BASE_KEYS") or set()
+    mod = _module_named(project, "sched.py")
+    if mod is not None:
+        keys |= _live_tuple(mod, "SCHED_RECORD_KEYS") or set()
+    if not keys:
+        keys = set(_SERVE_KEYS_FALLBACK)
+    return keys - set(_CONTEXT_KEYS)
+
+
 @rule("policy-recorded",
-      "pick_* resolvers in ops//models//utils/ stamp the bench-record key "
+      "pick_* resolvers in ops//models//utils//serve/ stamp the record key "
       "their decision lands in, or carry a rationale'd suppression")
 def policy_recorded(project: Project):
     """graftpilot's observability bar, applied to every auto policy: a
@@ -1364,16 +1417,23 @@ def policy_recorded(project: Project):
     the docstring must name, in double backticks, at least one key from
     ``RECORD_BASE_KEYS`` (live from bench.py when scanned) or the final
     record's extra keys — the place a reader of the record finds the
-    resolved value.  A resolver whose output is already a pure function
-    of recorded inputs may say exactly that in a rationale'd
-    suppression instead."""
-    keys = _bench_record_keys(project)
+    resolved value.  Resolvers in serve/ (graftsched's scheduling knobs)
+    may instead stamp a key of the SERVE records — serve_bench.py's
+    ``RECORD_BASE_KEYS`` or sched.py's ``SCHED_RECORD_KEYS``, the
+    per-request latency record.  A resolver whose output is already a
+    pure function of recorded inputs may say exactly that in a
+    rationale'd suppression instead."""
+    bench_keys = _bench_record_keys(project)
+    serve_keys = bench_keys | _serve_record_keys(project)
     findings = []
     for mod in project.modules:
         norm = mod.display.replace(os.sep, "/")
-        if not any(f"/{d}/" in norm or norm.startswith(f"{d}/")
-                   for d in ("ops", "models", "utils")):
+        in_serve = "/serve/" in norm or norm.startswith("serve/")
+        if not in_serve and not any(
+                f"/{d}/" in norm or norm.startswith(f"{d}/")
+                for d in ("ops", "models", "utils")):
             continue
+        keys = serve_keys if in_serve else bench_keys
         for node in ast.walk(mod.tree):
             if not (isinstance(node, ast.FunctionDef)
                     and node.name.startswith("pick_")):
@@ -1382,11 +1442,14 @@ def policy_recorded(project: Project):
             stamped = set(_BACKTICK_KEY_RE.findall(doc)) & keys
             if stamped:
                 continue
+            where = ("RECORD_BASE_KEYS, SCHED_RECORD_KEYS or the final "
+                     "record's extra keys" if in_serve else
+                     "RECORD_BASE_KEYS or the final record's extra keys")
             findings.append(mod.finding(
                 "policy-recorded", node,
-                f"policy resolver {node.name}() names no bench-record key "
+                f"policy resolver {node.name}() names no record key "
                 "in its docstring: stamp the key the resolved choice "
-                "lands in (double-backticked, from RECORD_BASE_KEYS or "
-                "the final record's extra keys), or suppress with the "
-                "rationale that the record already pins the decision"))
+                f"lands in (double-backticked, from {where}), or "
+                "suppress with the rationale that the record already "
+                "pins the decision"))
     return findings

@@ -70,6 +70,41 @@ def default_budget(backend: str) -> int | None:
     return HBM_BUDGET_BYTES.get(backend)
 
 
+def decide_residency(resident_peaks, model_id: str, peak_bytes: int,
+                     budget_bytes: int | None) -> Decision:
+    """Multi-model residency admission for the serve daemon
+    (graftsched): a new :class:`~tsne_flink_tpu.serve.model.FrozenModel`
+    is admitted only while
+
+        sum(transform peak of every resident model) + its own peak
+            <= the fleet HBM budget,
+
+    the serving analog of the fleet job gate above.  Each term is the
+    model's full ``transform_peak_bytes`` — model arrays PLUS its
+    per-bucket transients — which makes the sum conservative: the
+    daemon's double-buffered tick holds at most two bucket transients at
+    once, but every resident model's arrays stay resident simultaneously
+    (that refined split is what ``analysis/audit/hbm.residency_report``
+    reports; the gate deliberately charges the safe sum).  There is no
+    degrade rung here: a model either fits next to the resident set or
+    it is refused (``QUEUE``) and the daemon keeps serving what it has —
+    the refusal is recorded on the daemon's residency events either
+    way."""
+    in_use = int(sum(int(v) for v in resident_peaks.values()))
+    total = in_use + int(peak_bytes)
+    if budget_bytes is None or total <= int(budget_bytes):
+        return Decision(ADMIT, total, {},
+                        f"model {model_id} peak {int(peak_bytes)} joins "
+                        f"{len(resident_peaks)} resident model(s) "
+                        f"({in_use} bytes); total {total} fits budget "
+                        f"{budget_bytes}")
+    return Decision(QUEUE, total, {},
+                    f"model {model_id} peak {int(peak_bytes)} + resident "
+                    f"{in_use} = {total} exceeds budget "
+                    f"{int(budget_bytes)}; model refused, resident set "
+                    "unchanged")
+
+
 class AdmissionController:
     """Stateless policy: callers (the fleet) track ``in_use_bytes``."""
 

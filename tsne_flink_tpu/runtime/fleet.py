@@ -349,6 +349,14 @@ class ServeSpec:
     fault_plan: str | None = None
     job_timeout: float | None = None
     stage_timeout: float | None = None
+    # graftsched: scheduler + residency config (None = env defaults)
+    sched: str | None = None       # on | off | None = TSNE_SERVE_SCHED
+    deadline_ms: float | None = None
+    starve_ms: float | None = None
+    poll_max_ms: float | None = None
+    models: list | None = None     # extra resident models: [{"model":
+    #   ckpt, "input": npy, "perplexity"?, "learning_rate"?, "metric"?,
+    #   "neighbors"?, "repulsion"?, "activate"?: bool}, ...]
 
     def k(self) -> int:
         return (int(self.neighbors) if self.neighbors is not None
@@ -410,7 +418,25 @@ def run_serve(spec: ServeSpec) -> dict:
                             learning_rate=float(spec.learning_rate),
                             metric=spec.metric)
         daemon = ServeDaemon(model, spec.spool, bucket=spec.bucket,
-                             iters=spec.iters, eta=spec.eta, watchdog=wd)
+                             iters=spec.iters, eta=spec.eta, watchdog=wd,
+                             sched=spec.sched,
+                             deadline_ms=spec.deadline_ms,
+                             starve_ms=spec.starve_ms,
+                             poll_max_ms=spec.poll_max_ms)
+        for extra in (spec.models or []):
+            from tsne_flink_tpu.serve.model import frozen_from_files
+            daemon.load_model(
+                frozen_from_files(
+                    extra["model"], extra["input"],
+                    perplexity=float(extra.get("perplexity",
+                                               spec.perplexity)),
+                    learning_rate=float(extra.get("learning_rate",
+                                                  spec.learning_rate)),
+                    metric=extra.get("metric", spec.metric),
+                    neighbors=extra.get("neighbors", spec.neighbors),
+                    repulsion=extra.get("repulsion", spec.repulsion),
+                    name=spec.name),
+                activate=bool(extra.get("activate", False)))
         record.update(daemon.serve_forever(max_ticks=spec.max_ticks))
     except BaseException as e:
         record.update(status="error", error=f"{type(e).__name__}: {e}")

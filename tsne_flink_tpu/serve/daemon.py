@@ -1,4 +1,4 @@
-"""The long-lived embed daemon: a warm FrozenModel behind a spool directory.
+"""The long-lived embed daemon: warm FrozenModels behind a spool directory.
 
 Graftfleet's file conventions, inverted for serving.  A fleet job is one
 process per embedding; the daemon is ONE process answering many small
@@ -7,7 +7,8 @@ field, the three compiled stage executables — resident from the first
 request to the last:
 
 * **requests** are ``<id>.req.npz`` files (one float array ``x``,
-  ``[B, d]``) dropped into the spool directory.  :func:`submit` writes
+  ``[B, d]``, plus an optional ``model`` id string for multi-model
+  daemons) dropped into the spool directory.  :func:`submit` writes
   them atomically (tmp + rename, like every output writer in this repo),
   so the daemon never observes a torn request.
 * **claims** are ``utils/locks.FileLock`` on ``<id>.req.npz.lock`` — the
@@ -15,27 +16,45 @@ request to the last:
   SIGKILLed mid-request leaves a lock that the restarted daemon breaks
   after ``TSNE_LOCK_STALE_S`` and re-serves bit-identically (the
   transform has no RNG and the AOT cache is warm — pinned by the chaos
-  test in ``tests/test_serve.py``).
+  tests in ``tests/test_serve.py`` / ``tests/test_sched.py``).
 * **results** are ``<id>.res.npz`` (array ``y``) + ``<id>.lat.json``
-  (the per-request latency record: rows, buckets, seconds, model_id),
-  both atomic; the request file is deleted only AFTER the result lands,
-  so ``.res`` presence is the done marker and a crash between compute
-  and write just re-serves.
-* **micro-batching**: each tick coalesces claimed requests up to
-  ``TSNE_SERVE_MAX_BATCH`` rows and runs ONE transform over the
-  concatenation — per-row independence (serve/transform.py) makes the
-  split-back bit-identical to per-request serving, and the fixed bucket
-  shapes mean a warm daemon never recompiles.
+  (the per-request latency record), both atomic; the request file is
+  deleted only AFTER the result lands, so ``.res`` presence is the done
+  marker and a crash between compute and write just re-serves.  A
+  request the daemon cannot serve (unknown model, wrong width) gets an
+  atomic ``<id>.err.json`` instead.
+* **scheduling** (graftsched, ``TSNE_SERVE_SCHED=on``): claimed
+  requests ride :class:`~tsne_flink_tpu.serve.sched.MicroBatcher` —
+  deadline-driven bucket bin-packing with express/bulk lanes — through
+  a double-buffered tick that overlaps spool I/O with device compute
+  (``serve/sched.py`` module docstring has the state machine).  With
+  ``TSNE_SERVE_SCHED=off`` each tick is the PR-14 serial drain: claim
+  up to ``TSNE_SERVE_MAX_BATCH`` rows, ONE coalesced transform,
+  behavior-identical to graftserve.
+* **multi-model residency + hot-swap**: the daemon holds several
+  FrozenModels keyed by ``model_id``, each admitted against the fleet
+  HBM budget via the ``transform_peak_bytes`` sum
+  (``runtime/admission.decide_residency``); a refused model leaves the
+  resident set unchanged and the refusal on the residency events.
+  :meth:`ServeDaemon.load_model` + :meth:`ServeDaemon.activate` swap
+  the default model atomically between ticks — requests bind their
+  model at claim, so no in-flight request ever mixes models and every
+  response's ``model_id`` names the model active at its dispatch.  A
+  ``<name>.swap.json`` control file in the spool does the same for a
+  daemon running in another process (checkpoint + input paths; the
+  daemon answers with ``<name>.swap.done.json``).
 
 PR-8 conventions ride along: the fleet :class:`~tsne_flink_tpu.runtime.
 fleet.Watchdog` beats every tick (a hung device stalls the beat and the
 watchdog kills the process — exit 124 — rather than silently wedging the
 spool), and the ``serve`` fault site fires at tick start (oom / delay /
 nan rehearsal) and at the post-compute request boundary (kill@serve —
-the crash window the chaos test aims at).  Startup admission-checks the
-model + bucket against the graftcheck HBM budget
-(:meth:`FrozenModel.admission_report`) before going warm — the same
-"predict, then commit" contract the fleet scheduler enforces per job.
+the crash window the chaos tests aim at).  Startup admission-checks the
+model + bucket against the graftcheck HBM budget before going warm —
+the same "predict, then commit" contract the fleet scheduler enforces
+per job.  The spool poll backs off adaptively while idle: the interval
+starts at ``TSNE_SERVE_TICK_S`` after any work and doubles per empty
+scan up to ``TSNE_SERVE_POLL_MAX_MS``.
 """
 
 from __future__ import annotations
@@ -49,6 +68,11 @@ import numpy as np
 from tsne_flink_tpu.obs import trace as obtrace
 from tsne_flink_tpu.obs.trace import walltime
 from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.serve.sched import (MicroBatcher, Request,
+                                        pick_poll_max_ms,
+                                        pick_serve_deadline_ms,
+                                        pick_serve_sched,
+                                        pick_serve_starve_ms)
 from tsne_flink_tpu.utils.env import env_float, env_int, env_str
 from tsne_flink_tpu.utils.io import atomic_write
 from tsne_flink_tpu.utils.locks import FileLock
@@ -56,11 +80,15 @@ from tsne_flink_tpu.utils.locks import FileLock
 REQ_SUFFIX = ".req.npz"
 RES_SUFFIX = ".res.npz"
 LAT_SUFFIX = ".lat.json"
+ERR_SUFFIX = ".err.json"
+SWAP_SUFFIX = ".swap.json"
+SWAP_DONE_SUFFIX = ".swap.done.json"
 
 
 def pick_spool(spool: str | None = None) -> str:
     """The spool directory: the explicit argument, else
-    ``TSNE_SERVE_SPOOL``.  Recorded on every serve record as ``spool``."""
+    ``TSNE_SERVE_SPOOL``.  Recorded on every serve summary as
+    ``spool``."""
     got = spool or env_str("TSNE_SERVE_SPOOL")
     if not got:
         raise ValueError("no spool directory: pass spool= or set "
@@ -68,8 +96,10 @@ def pick_spool(spool: str | None = None) -> str:
     return str(got)
 
 
-def submit(spool: str, x, req_id: str) -> str:
-    """Drop one request into the spool (atomic) and return its path."""
+def submit(spool: str, x, req_id: str, model_id: str | None = None) -> str:
+    """Drop one request into the spool (atomic) and return its path.
+    ``model_id`` pins the request to a specific resident model; None
+    serves with whichever model is active at claim time."""
     xq = np.ascontiguousarray(np.asarray(x))
     if xq.ndim != 2:
         raise ValueError(f"request must be [B, d], got {xq.shape}")
@@ -77,7 +107,10 @@ def submit(spool: str, x, req_id: str) -> str:
 
     def write(tmp):
         with open(tmp, "wb") as f:
-            np.savez(f, x=xq)
+            if model_id is None:
+                np.savez(f, x=xq)
+            else:
+                np.savez(f, x=xq, model=np.asarray(str(model_id)))
     atomic_write(path, write)
     return path
 
@@ -96,20 +129,24 @@ def _req_id(req_path: str) -> str:
 
 
 class ServeDaemon:
-    """The warm process: model resident, executables compiled, spool
-    polled every ``tick_s`` until stopped (or idle past
-    ``TSNE_SERVE_IDLE_EXIT_S``)."""
+    """The warm process: models resident, executables compiled, spool
+    polled (with adaptive backoff) until stopped or idle past
+    ``TSNE_SERVE_IDLE_EXIT_S``."""
 
     def __init__(self, model, spool: str | None = None, *,
                  bucket: int | None = None, iters: int | None = None,
                  eta: float | None = None,
                  tick_s: float | None = None, max_batch: int | None = None,
                  idle_exit_s: float | None = None, watchdog=None,
-                 budget_bytes=None):
+                 budget_bytes=None, sched: str | None = None,
+                 deadline_ms: float | None = None,
+                 starve_ms: float | None = None,
+                 poll_max_ms: float | None = None):
         from tsne_flink_tpu.serve.transform import (pick_serve_bucket,
                                                     pick_transform_eta,
                                                     pick_transform_iters)
-        self.model = model
+        self.models = {model.model_id: model}
+        self.active_id = model.model_id
         self.spool = pick_spool(spool)
         self.bucket = pick_serve_bucket(bucket)
         self.iters = pick_transform_iters(iters)
@@ -122,11 +159,43 @@ class ServeDaemon:
                 else env_float("TSNE_SERVE_IDLE_EXIT_S"))
         self.idle_exit_s = idle if idle else None  # unset/0 = run forever
         self.watchdog = watchdog
+        self.sched = pick_serve_sched(sched)
+        self.deadline_ms = pick_serve_deadline_ms(deadline_ms)
+        self.starve_ms = pick_serve_starve_ms(starve_ms)
+        self.poll_max_s = pick_poll_max_ms(poll_max_ms) / 1e3
+        self.batcher = MicroBatcher(self.bucket,
+                                    deadline_s=self.deadline_ms / 1e3,
+                                    starve_s=self.starve_ms / 1e3)
+        self.inflight: list = []   # dispatched, unmaterialized batches
+        self.depth = 2             # double-buffered tick
+        # sched-mode claim horizon: how far into the spool the scheduler
+        # may look for reordering.  Unlike ``max_batch`` (which bounds
+        # PER-TICK device rows, an HBM concern), claimed-but-unpacked
+        # requests are host numpy + a held lock — the only device work
+        # is one bucket at a time — so the horizon is wide: a small
+        # request deep in the backlog cannot overtake work it was never
+        # claimed into.  16x max_batch bounds host RAM against an
+        # unbounded spool flood.
+        self.claim_rows = 16 * self.max_batch
+        self._claimed: dict[str, Request] = {}  # held across sched ticks
+        self._poll_s = self.tick_s
+        self._batches = 0
+        self._fills: list[float] = []
+        self._swaps = 0
+        self.failed = 0
+        self._progress = False
         self.latencies_s: list[float] = []
         self.served = 0
+        self.residency_events: list[dict] = []
         self.admission = self._admit(budget_bytes)
 
-    # ---- admission ---------------------------------------------------------
+    @property
+    def model(self):
+        """The active FrozenModel (requests without an explicit
+        ``model_id`` bind to it at claim time)."""
+        return self.models[self.active_id]
+
+    # ---- admission / residency ---------------------------------------------
 
     def _admit(self, budget_bytes) -> dict:
         """Predict-then-commit: the graftcheck HBM report of this model
@@ -135,17 +204,79 @@ class ServeDaemon:
         on a footing the audit says will OOM."""
         import jax
 
-        from tsne_flink_tpu.analysis.audit.hbm import transform_peak_bytes
         from tsne_flink_tpu.runtime.admission import default_budget
         budget = (int(budget_bytes) if budget_bytes
                   else default_budget(jax.default_backend()))
-        peak = transform_peak_bytes(self.model.serve_plan(self.bucket))
+        peak = self.model.transform_peak(self.bucket)
+        self._peaks = {self.active_id: peak}
         if budget is not None and peak > budget:
             raise RuntimeError(
                 f"serve admission: predicted peak {peak} bytes exceeds "
                 f"budget {budget} for bucket={self.bucket} "
                 f"(model n={self.model.n}); shrink TSNE_SERVE_BUCKET")
         return {"peak_bytes": peak, "budget_bytes": budget}
+
+    def load_model(self, model, *, activate: bool = False,
+                   warm: bool = True) -> dict:
+        """Admit ``model`` into the resident set (graftsched residency:
+        its transform peak joins the sum of resident peaks against the
+        fleet budget).  A refused model leaves the set unchanged; either
+        way the decision lands on the residency events.  ``warm``
+        compiles (or AOT warm-loads) its stage executables NOW, so a
+        later swap never compiles on the serving path."""
+        from tsne_flink_tpu.runtime.admission import ADMIT, decide_residency
+        mid = model.model_id
+        if mid in self.models:
+            event = {"op": "load", "model_id": mid, "action": "resident",
+                     "reason": "already resident"}
+        else:
+            peak = model.transform_peak(self.bucket)
+            decision = decide_residency(self._peaks, mid, peak,
+                                        self.admission["budget_bytes"])
+            event = {"op": "load", "model_id": mid,
+                     "action": decision.action,
+                     "predicted_peak": int(decision.predicted_peak),
+                     "reason": decision.reason}
+            if decision.action == ADMIT:
+                self.models[mid] = model
+                self._peaks[mid] = peak
+                if warm:
+                    from tsne_flink_tpu.serve.transform import warm_stages
+                    event["aot"] = ",".join(warm_stages(
+                        model, bucket=self.bucket, iters=self.iters,
+                        eta=self.eta))
+        self.residency_events.append(event)
+        obtrace.instant("serve.load_model", cat="serve", model=mid,
+                        action=event["action"])
+        if activate and mid in self.models:
+            event["activated_from"] = self.activate(mid)
+        return event
+
+    def activate(self, model_id: str) -> str:
+        """Atomically make ``model_id`` the default serving model and
+        return the previous active id.  Takes effect for requests
+        claimed AFTER this call; already-claimed requests keep the model
+        they bound at claim (no response ever mixes or trails a swap)."""
+        if model_id not in self.models:
+            raise KeyError(f"model {model_id} is not resident")
+        prev, self.active_id = self.active_id, str(model_id)
+        if prev != self.active_id:
+            self._swaps += 1
+            self.residency_events.append(
+                {"op": "activate", "model_id": self.active_id,
+                 "from": prev})
+            obtrace.instant("serve.swap", cat="serve",
+                            model=self.active_id, prev=prev)
+        return prev
+
+    def evict(self, model_id: str) -> None:
+        """Drop a non-active model from the resident set (frees its
+        budget charge; its device arrays free with the last reference)."""
+        if model_id == self.active_id:
+            raise ValueError(f"cannot evict the active model {model_id}")
+        self.models.pop(model_id, None)
+        self._peaks.pop(model_id, None)
+        self.residency_events.append({"op": "evict", "model_id": model_id})
 
     # ---- request plumbing --------------------------------------------------
 
@@ -158,10 +289,10 @@ class ServeDaemon:
                       if n.endswith(REQ_SUFFIX))
 
     def _claim(self, req_path: str):
-        """The request's rows if we hold its lock and it is unserved,
-        else None.  A torn/unreadable file stays claimed-by-nobody until
-        its writer finishes the rename (writes are atomic, so this only
-        means 'not ours this tick')."""
+        """The request's (lock, rows, model_id) if we hold its lock and
+        it is unserved, else None.  A torn/unreadable file stays
+        claimed-by-nobody until its writer finishes the rename (writes
+        are atomic, so this only means 'not ours this tick')."""
         if os.path.exists(os.path.join(
                 self.spool, _req_id(req_path) + RES_SUFFIX)):
             # served before a crash could delete the request: finish the
@@ -176,13 +307,32 @@ class ServeDaemon:
             return None
         try:
             with np.load(req_path) as z:
-                return lock, np.asarray(z["x"])
+                x = np.asarray(z["x"])
+                mid = (str(z["model"].item()) if "model" in z.files
+                       else None)
+                return lock, x, mid
         except (OSError, KeyError, ValueError):
             lock.release()
             return None
 
+    def _fail(self, req_path: str, lock: FileLock, reason: str) -> None:
+        """Refuse one request (unknown model, wrong width): atomic
+        ``.err.json`` so the client stops waiting, request deleted."""
+        rid = _req_id(req_path)
+
+        def write_err(tmp):
+            with open(tmp, "w") as f:
+                json.dump({"req": rid, "error": reason}, f)
+        atomic_write(os.path.join(self.spool, rid + ERR_SUFFIX), write_err)
+        try:
+            os.remove(req_path)
+        except OSError:
+            pass
+        lock.release()
+        self.failed += 1
+
     def _finish(self, req_path: str, lock: FileLock, y: np.ndarray,
-                seconds: float) -> None:
+                seconds: float, *, model_id: str | None = None) -> None:
         rid = _req_id(req_path)
         res = os.path.join(self.spool, rid + RES_SUFFIX)
 
@@ -197,7 +347,7 @@ class ServeDaemon:
                            "seconds": round(float(seconds), 6),
                            "bucket": self.bucket, "iters": self.iters,
                            "eta": self.eta,
-                           "model_id": self.model.model_id}, f)
+                           "model_id": model_id or self.active_id}, f)
         atomic_write(os.path.join(self.spool, rid + LAT_SUFFIX), write_lat)
         try:
             os.remove(req_path)
@@ -207,18 +357,78 @@ class ServeDaemon:
         self.latencies_s.append(float(seconds))
         self.served += 1
 
-    # ---- the tick ----------------------------------------------------------
+    # ---- hot-swap control files --------------------------------------------
+
+    def _control_pass(self) -> int:
+        """Process ``<name>.swap.json`` control files: load (and
+        optionally activate) a model named by checkpoint + input paths,
+        answer with ``<name>.swap.done.json``.  Control errors land in
+        the done file — they must never take the serving loop down."""
+        try:
+            names = os.listdir(self.spool)
+        except OSError:
+            return 0
+        handled = 0
+        for name in sorted(names):
+            if not name.endswith(SWAP_SUFFIX):
+                continue
+            path = os.path.join(self.spool, name)
+            lock = FileLock(path + ".lock")
+            if not lock.acquire(timeout_s=0.0):
+                continue
+            try:
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        spec = json.load(f)
+                except (OSError, ValueError):
+                    continue   # torn/absent: not ours this tick
+                out = {"op": "swap", "status": "ok"}
+                try:
+                    from tsne_flink_tpu.serve.model import frozen_from_files
+                    model = frozen_from_files(
+                        spec["model"], spec["input"],
+                        perplexity=float(spec.get("perplexity", 10.0)),
+                        learning_rate=float(spec.get("learning_rate",
+                                                     1000.0)),
+                        metric=spec.get("metric", "sqeuclidean"),
+                        neighbors=spec.get("neighbors"),
+                        repulsion=spec.get("repulsion", "auto"),
+                        name=name[:-len(SWAP_SUFFIX)])
+                    out.update(self.load_model(
+                        model, activate=bool(spec.get("activate", True))))
+                except Exception as e:  # control-plane isolation
+                    out.update(status="error",
+                               error=f"{type(e).__name__}: {e}")
+                done = path[:-len(SWAP_SUFFIX)] + SWAP_DONE_SUFFIX
+
+                def write_done(tmp):
+                    with open(tmp, "w") as f:
+                        json.dump(out, f)
+                atomic_write(done, write_done)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                handled += 1
+            finally:
+                lock.release()
+        return handled
+
+    # ---- the serial tick (TSNE_SERVE_SCHED=off — the PR-14 drain) ----------
 
     def drain_once(self) -> int:
-        """One tick: claim pending requests up to ``max_batch`` rows,
-        serve them through ONE coalesced transform, write results.
-        Returns the number of requests completed."""
+        """One serial tick: claim pending requests up to ``max_batch``
+        rows, serve them through ONE coalesced transform per bound
+        model (a single concatenation when no request pins a model —
+        graftserve's exact path), write results.  Returns the number of
+        requests completed."""
         from tsne_flink_tpu.serve.transform import transform
 
         inj = faults.injector()
         if inj:
             inj.fire("serve")  # oom / delay / nan rehearsal at tick start
-        claimed: list[tuple[str, FileLock, np.ndarray]] = []
+        self._control_pass()
+        claimed: list[tuple[str, FileLock, np.ndarray, str]] = []
         rows = 0
         for req_path in self._pending():
             if rows >= self.max_batch:
@@ -226,8 +436,11 @@ class ServeDaemon:
             got = self._claim(req_path)
             if got is None:
                 continue
-            lock, x = got
-            claimed.append((req_path, lock, x))
+            lock, x, mid = got
+            if mid is not None and mid not in self.models:
+                self._fail(req_path, lock, f"model {mid} not resident")
+                continue
+            claimed.append((req_path, lock, x, mid or self.active_id))
             rows += int(x.shape[0])
         if not claimed:
             return 0
@@ -235,67 +448,325 @@ class ServeDaemon:
         try:
             with obtrace.span("serve.drain", cat="serve", requests=len(
                     claimed), rows=rows) as sp:
-                xs = np.concatenate([x for _, _, x in claimed], axis=0)
-                y = transform(self.model, xs, bucket=self.bucket,
-                              iters=self.iters, eta=self.eta)
+                order: list[str] = []
+                for _, _, _, mid in claimed:
+                    if mid not in order:
+                        order.append(mid)
+                ys, offs = {}, {}
+                for mid in order:
+                    xs = np.concatenate(
+                        [x for _, _, x, m in claimed if m == mid], axis=0)
+                    ys[mid] = transform(self.models[mid], xs,
+                                        bucket=self.bucket,
+                                        iters=self.iters, eta=self.eta)
+                    offs[mid] = 0
             per_req = sp.seconds / len(claimed)
-            off = 0
-            for req_path, lock, x in claimed:
+            for req_path, lock, x, mid in claimed:
                 b = int(x.shape[0])
                 if inj:
                     # kill@serve lands HERE: after compute, before this
                     # request's result write — the restarted daemon finds
                     # the request file intact and re-serves bit-identically
                     inj.fire("serve", seg=self.served, point="boundary")
-                self._finish(req_path, lock, y[off:off + b], per_req)
-                off += b
+                off = offs[mid]
+                self._finish(req_path, lock, ys[mid][off:off + b], per_req,
+                             model_id=mid)
+                offs[mid] = off + b
                 done += 1
             claimed = []
         finally:
-            for _, lock, _ in claimed:
+            for _, lock, _, _ in claimed:
                 lock.release()  # crash path: unserved claims unlock now
         return done
+
+    # ---- the scheduled tick (TSNE_SERVE_SCHED=on — graftsched) -------------
+
+    def _claim_pass(self) -> int:
+        """Claim new requests into the batcher (binding each to its
+        model at claim) until the pending backlog reaches the claim
+        horizon (``16 x max_batch`` rows — see ``__init__``; the
+        scheduler can only reorder work it has claimed).  Runs while
+        earlier batches compute on the device — the spool I/O half of
+        the pipelined tick."""
+        new = 0
+        for req_path in self._pending():
+            if req_path in self._claimed:
+                continue   # ours already, riding the batcher
+            if self.batcher.pending_rows() >= self.claim_rows:
+                break
+            got = self._claim(req_path)
+            if got is None:
+                continue
+            lock, x, mid = got
+            if mid is not None and mid not in self.models:
+                self._fail(req_path, lock, f"model {mid} not resident")
+                continue
+            bound = mid or self.active_id
+            model = self.models[bound]
+            xd = np.ascontiguousarray(x)
+            if xd.ndim != 2 or xd.shape[1] != int(model.x.shape[1]):
+                self._fail(req_path, lock,
+                           f"queries must be [B, {int(model.x.shape[1])}],"
+                           f" got {tuple(xd.shape)}")
+                continue
+            # .dtype, never a device slice: nothing on the claim path may
+            # touch the device (a [1] gather would compile mid-drain)
+            xd = xd.astype(np.dtype(model.x.dtype), copy=False)
+            req = Request(_req_id(req_path), req_path, lock, xd, bound,
+                          arrival=walltime(),
+                          deadline_s=self.deadline_ms / 1e3,
+                          seq=self.batcher.next_seq(), bucket=self.bucket,
+                          out_width=int(model.y.shape[1]),
+                          out_dtype=np.dtype(model.y.dtype),
+                          poll_ms=self._poll_s * 1e3)
+            self._claimed[req_path] = req
+            if req.rows == 0:
+                # degenerate empty request: finish without a batch
+                req.first_dispatch = req.compute_done = req.arrival
+                inj = faults.injector()
+                if inj:
+                    inj.fire("serve", seg=self.served, point="boundary")
+                self._finish_sched(req)
+            else:
+                self.batcher.add(req)
+            new += 1
+        return new
+
+    def _dispatch(self, batch) -> None:
+        """Pack one bucket and enqueue its compute WITHOUT blocking
+        (JAX async dispatch): the device works while the loop goes back
+        to spool I/O.  Unfilled tail rows are zero padding — per-row
+        independence makes them inert."""
+        from tsne_flink_tpu.serve.transform import dispatch_bucket
+        model = self.models[batch.model_id]
+        qp = np.zeros((self.bucket, int(model.x.shape[1])),
+                      dtype=np.dtype(model.x.dtype))
+        for req, start, nrow, off in batch.parts:
+            qp[off:off + nrow] = req.x[start:start + nrow]
+        batch.handle = dispatch_bucket(model, qp, bucket=self.bucket,
+                                       iters=self.iters, eta=self.eta)
+        batch.t_dispatch = walltime()
+        for req, _, _, _ in batch.parts:
+            if req.first_dispatch is None:
+                req.first_dispatch = batch.t_dispatch
+        self.inflight.append(batch)
+        self._batches += 1
+        self._fills.append(batch.fill)
+        obtrace.instant("serve.dispatch", cat="serve", rows=batch.rows,
+                        fill=round(batch.fill, 3), model=batch.model_id,
+                        inflight=len(self.inflight))
+
+    def _resolve(self, batch) -> int:
+        """Materialize one batch (blocks until ITS compute lands; later
+        batches keep computing behind it) and scatter the rows back to
+        their requests; completed requests write out — the result I/O
+        overlaps the next batch's device compute."""
+        with obtrace.span("serve.resolve", cat="serve", rows=batch.rows,
+                          fill=round(batch.fill, 3),
+                          model=batch.model_id):
+            y = np.asarray(batch.handle)
+        batch.handle = None
+        t_done = walltime()
+        inj = faults.injector()
+        done = 0
+        for req, start, nrow, off in batch.parts:
+            req.out[start:start + nrow] = y[off:off + nrow]
+            req.done_rows += nrow
+            req.slices += 1
+            req.fills.append(batch.fill)
+            if req.complete():
+                req.compute_done = t_done
+                if inj:
+                    # kill@serve: post-compute, pre-write — the same
+                    # crash window as the serial drain
+                    inj.fire("serve", seg=self.served, point="boundary")
+                self._finish_sched(req)
+                done += 1
+        return done
+
+    def _finish_sched(self, req: Request) -> None:
+        """Write one scheduled request's result + extended latency
+        record (queue/compute/write split, lane, fill — every
+        scheduling decision, recorded)."""
+        res = os.path.join(self.spool, req.rid + RES_SUFFIX)
+        t_w0 = walltime()
+
+        def write_res(tmp):
+            with open(tmp, "wb") as f:
+                np.savez(f, y=req.out)
+        atomic_write(res, write_res)
+        write_ms = (walltime() - t_w0) * 1e3
+        first = req.first_dispatch if req.first_dispatch else req.arrival
+        comp = req.compute_done if req.compute_done else first
+        seconds = walltime() - req.arrival
+        lat = {"req": req.rid, "rows": req.rows,
+               "seconds": round(float(seconds), 6),
+               "bucket": self.bucket, "iters": self.iters,
+               "eta": self.eta, "model_id": req.model_id,
+               "sched": "on", "lane": req.lane,
+               "promoted": bool(req.promoted), "slices": req.slices,
+               "batch_fill": (round(float(np.mean(req.fills)), 4)
+                              if req.fills else 0.0),
+               "queue_ms": round((first - req.arrival) * 1e3, 3),
+               "compute_ms": round((comp - first) * 1e3, 3),
+               "write_ms": round(write_ms, 3),
+               "deadline_ms": self.deadline_ms,
+               "starve_ms": self.starve_ms,
+               "poll_ms": round(req.poll_ms, 3)}
+
+        def write_lat(tmp):
+            with open(tmp, "w") as f:
+                json.dump(lat, f)
+        atomic_write(os.path.join(self.spool, req.rid + LAT_SUFFIX),
+                     write_lat)
+        try:
+            os.remove(req.path)
+        except OSError:
+            pass
+        req.lock.release()
+        self._claimed.pop(req.path, None)
+        self.latencies_s.append(float(seconds))
+        self.served += 1
+
+    def _sched_tick(self) -> int:
+        """One double-buffered tick: fault site, control + claim pass
+        (overlapping in-flight compute), dispatch up to ``depth``
+        batches, then materialize the OLDEST in-flight batch — its
+        result writes overlap the device compute of the batch behind
+        it.  Returns requests completed; sets ``_progress`` for the
+        adaptive poll."""
+        inj = faults.injector()
+        if inj:
+            inj.fire("serve")  # oom / delay / nan rehearsal at tick start
+        progress = bool(self._control_pass())
+        progress = bool(self._claim_pass()) or progress
+        now = walltime()
+        while (len(self.inflight) < self.depth
+               and self.batcher.ready(now,
+                                      device_idle=not self.inflight)):
+            batch = self.batcher.next_batch(now)
+            if batch is None:
+                break
+            self._dispatch(batch)
+            progress = True
+            now = walltime()
+        done = 0
+        if self.inflight:
+            done = self._resolve(self.inflight.pop(0))
+            progress = True
+        self._progress = progress
+        return done
+
+    def _busy(self) -> bool:
+        return bool(self.inflight) or bool(self.batcher.pending)
+
+    def _shutdown_flush(self) -> None:
+        """Clean-exit epilogue: materialize every in-flight batch (their
+        completed requests finish normally), then release claims on
+        never-finished requests — their files stay in the spool for the
+        next daemon, which re-serves them whole (results only ever land
+        complete)."""
+        while self.inflight:
+            self._resolve(self.inflight.pop(0))
+        for req in self.batcher.abandon():
+            self._claimed.pop(req.path, None)
+            req.lock.release()
+        for req in list(self._claimed.values()):
+            # partially dispatched, never completed: same story
+            self._claimed.pop(req.path, None)
+            req.lock.release()
+
+    # ---- the loop ----------------------------------------------------------
 
     def serve_forever(self, max_ticks: int | None = None) -> dict:
         """Poll the spool until ``max_ticks`` (tests) or idle-exit.  The
         watchdog (when armed) beats once per tick — a wedged transform
-        stops the beat and the watchdog takes the process down."""
+        stops the beat and the watchdog takes the process down.  The
+        poll interval backs off exponentially while idle (up to
+        ``TSNE_SERVE_POLL_MAX_MS``) and snaps back to ``tick_s`` on any
+        progress."""
         if self.watchdog is not None:
             self.watchdog.start()
         last_work = walltime()
         ticks = 0
+        poll = self.tick_s
         try:
             while max_ticks is None or ticks < max_ticks:
                 ticks += 1
-                n = self.drain_once()
+                if self.sched == "on":
+                    n = self._sched_tick()
+                    progress = self._progress
+                else:
+                    n = self.drain_once()
+                    progress = n > 0
                 if self.watchdog is not None:
                     self.watchdog.beat("serve")
                 now = walltime()
-                if n:
+                if progress:
                     last_work = now
-                elif (self.idle_exit_s is not None
-                      and now - last_work > float(self.idle_exit_s)):
-                    break
-                if n == 0:
-                    time.sleep(self.tick_s)
+                    poll = self.tick_s
+                else:
+                    if (self.idle_exit_s is not None and not self._busy()
+                            and now - last_work > float(self.idle_exit_s)):
+                        break
+                    sleep_s = poll
+                    if self.sched == "on":
+                        edl = self.batcher.earliest_deadline()
+                        if edl is not None:
+                            # wake for the coalescing deadline, not after
+                            sleep_s = min(sleep_s,
+                                          max(edl - now, 0.0) + 1e-4)
+                    time.sleep(sleep_s)
+                    poll = min(poll * 2.0, self.poll_max_s)
+                self._poll_s = poll
         finally:
-            if self.watchdog is not None:
-                self.watchdog.stop()
+            try:
+                if self.sched == "on":
+                    self._shutdown_flush()
+            except Exception:
+                pass   # exit path must never mask the original failure
+            finally:
+                if self.watchdog is not None:
+                    self.watchdog.stop()
         return self.summary()
 
     # ---- evidence ----------------------------------------------------------
 
     def summary(self) -> dict:
-        """The serving summary: request count + latency percentiles, the
-        shape the serve bench record pins."""
+        """The serving summary: request count + latency percentiles +
+        every scheduling/residency knob, the shape the serve bench
+        record pins."""
         lat = sorted(self.latencies_s)
         return {"served": self.served,
                 "p50_ms": round(_pct(lat, 0.50) * 1e3, 3),
                 "p99_ms": round(_pct(lat, 0.99) * 1e3, 3),
                 "bucket": self.bucket, "iters": self.iters,
                 "eta": self.eta,
-                "model_id": self.model.model_id,
-                "admission": self.admission}
+                "model_id": self.active_id,
+                "spool": self.spool,
+                "admission": self.admission,
+                "sched": self.sched,
+                "deadline_ms": self.deadline_ms,
+                "starve_ms": self.starve_ms,
+                "poll_max_ms": round(self.poll_max_s * 1e3, 3),
+                "batches": self._batches,
+                "batch_fill_mean": (round(float(np.mean(self._fills)), 4)
+                                    if self._fills else None),
+                "promotions": self.batcher.promotions,
+                "swaps": self._swaps,
+                "failed": self.failed,
+                "residency": self._residency_summary()}
+
+    def _residency_summary(self) -> dict:
+        from tsne_flink_tpu.analysis.audit.hbm import residency_report
+        return {"resident": list(self.models),
+                "active": self.active_id,
+                "resident_peak_sum": int(sum(self._peaks.values())),
+                "budget_bytes": self.admission["budget_bytes"],
+                "report": residency_report(
+                    [m.serve_plan(self.bucket)
+                     for m in self.models.values()]),
+                "events": list(self.residency_events)}
 
 
 def _pct(sorted_vals: list[float], q: float) -> float:

@@ -97,6 +97,14 @@ class FrozenModel:
         from tsne_flink_tpu.analysis.audit.hbm import plan_hbm_report
         return plan_hbm_report(self.serve_plan(bucket))
 
+    def transform_peak(self, bucket: int) -> int:
+        """Predicted transform-stage HBM peak (bytes) of this model
+        serving ``bucket``-row buckets — the per-model term graftsched's
+        multi-model residency admission sums against the fleet budget
+        (:func:`tsne_flink_tpu.runtime.admission.decide_residency`)."""
+        from tsne_flink_tpu.analysis.audit.hbm import transform_peak_bytes
+        return int(transform_peak_bytes(self.serve_plan(int(bucket))))
+
 
 def from_arrays(x, y, plan: PlanConfig, *, perplexity: float = 30.0,
                 learning_rate: float = 1000.0, metric: str = "sqeuclidean",
@@ -151,3 +159,26 @@ def load_frozen(ckpt_path: str, x, plan: PlanConfig, *,
     return from_arrays(x_arr, state.y, plan, perplexity=perplexity,
                        learning_rate=learning_rate, metric=metric,
                        ckpt_hash=content_hash)
+
+
+def frozen_from_files(ckpt_path: str, input_path: str, *,
+                      perplexity: float = 10.0,
+                      learning_rate: float = 1000.0,
+                      metric: str = "sqeuclidean",
+                      neighbors: int | None = None,
+                      repulsion: str = "auto",
+                      name: str = "swap") -> FrozenModel:
+    """Build a FrozenModel from (checkpoint, input .npy) paths — the
+    loader behind ``ServeSpec.models`` entries and the daemon's
+    ``<name>.swap.json`` hot-swap control files, sharing
+    :func:`load_frozen`'s strict verified open."""
+    import jax
+
+    x = np.load(input_path)
+    k = (int(neighbors) if neighbors is not None
+         else 3 * int(perplexity))
+    plan = PlanConfig(n=int(x.shape[0]), d=int(x.shape[1]), k=k,
+                      backend=jax.default_backend(), repulsion=repulsion,
+                      name=f"serve-load-{name}")
+    return load_frozen(ckpt_path, x, plan, perplexity=float(perplexity),
+                       learning_rate=float(learning_rate), metric=metric)

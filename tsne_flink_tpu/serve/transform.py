@@ -220,6 +220,50 @@ def _stages_for(model, bucket: int, iters: int, eta: float) -> _Stages:
     return got
 
 
+def dispatch_bucket(model, q_padded, *, bucket: int | None = None,
+                    iters: int | None = None, eta: float | None = None):
+    """Dispatch the three serve stages over ONE pre-padded
+    ``[bucket, d]`` array and return the device-resident ``[bucket, m]``
+    result WITHOUT materializing it.
+
+    This is graftsched's slice-level entry point: JAX async dispatch
+    means the call returns as soon as the work is enqueued, so the
+    daemon's double-buffered tick overlaps spool I/O (claim/decode,
+    result writes) with device compute.  ``np.asarray`` on the returned
+    handle blocks until the bytes exist.  Same executables, same padding
+    semantics as :func:`transform` — per-row independence makes a bucket
+    packed from MANY requests bit-identical to serving each alone."""
+    import jax.numpy as jnp
+
+    bucket = pick_serve_bucket(bucket)
+    iters = pick_transform_iters(iters)
+    eta = pick_transform_eta(eta)
+    stages = _stages_for(model, bucket, iters, eta)
+    q = jnp.asarray(q_padded)
+    if q.shape[0] != bucket or q.shape[1] != model.x.shape[1]:
+        raise ValueError(f"dispatch_bucket wants [{bucket}, "
+                         f"{model.x.shape[1]}] pre-padded, got {q.shape}")
+    idx, dist = stages.knn(q, model.x)
+    p, y0 = stages.init(dist, idx, model.y)
+    return stages.optimize(y0, idx, p, model.y, *stages.rep_args)
+
+
+def warm_stages(model, *, bucket: int | None = None,
+                iters: int | None = None,
+                eta: float | None = None) -> tuple:
+    """Compile (or AOT warm-load) the three stage executables for
+    ``model`` and return their cache states.  The daemon calls this at
+    model-load time so a hot-swapped model never compiles on the serving
+    path (the committed record's ``compile_seconds == 0`` claim holds
+    across swaps)."""
+    bucket = pick_serve_bucket(bucket)
+    iters = pick_transform_iters(iters)
+    eta = pick_transform_eta(eta)
+    transform(model, np.asarray(model.x[:1]), bucket=bucket,
+              iters=iters, eta=eta)
+    return _stages_for(model, bucket, iters, eta).cache_states()
+
+
 def transform(model, x_new, *, bucket: int | None = None,
               iters: int | None = None,
               eta: float | None = None) -> np.ndarray:

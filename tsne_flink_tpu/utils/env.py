@@ -280,6 +280,40 @@ _declare("TSNE_SERVE_IDLE_EXIT_S", "float", None,
          "exits cleanly (tests and batch drains); unset/0 = run forever "
          "(production daemon mode, killed by signal).")
 
+# ---- graftsched (tsne_flink_tpu/serve/sched.py) ----------------------------
+_declare("TSNE_SERVE_SCHED", "str", "on",
+         "Serve-daemon scheduler mode (serve/sched.py). 'on' = "
+         "deadline-driven micro-batching: claimed requests are split into "
+         "bucket-width slices, bin-packed express-lane-first into the "
+         "fixed TSNE_SERVE_BUCKET executables, and dispatched through a "
+         "double-buffered pipelined tick. 'off' = the PR-14 serial "
+         "coalescing drain, behavior-identical to graftserve. Rides every "
+         "latency record and the bench serve block as 'sched'.",
+         choices=("on", "off"))
+_declare("TSNE_SERVE_DEADLINE_MS", "float", 50.0,
+         "Per-bucket slack unit of the serve scheduler's deadlines: each "
+         "claimed request gets deadline arrival + DEADLINE_MS * "
+         "rows/bucket, so slack is proportional to the work carried and "
+         "the EDF drain orders small requests ahead of same-instant big "
+         "ones (an idle device dispatches immediately — the scheduler is "
+         "work-conserving). Bounds the batching-induced queue wait; "
+         "rides latency records as 'deadline_ms'.")
+_declare("TSNE_SERVE_STARVE_MS", "float", 30000.0,
+         "Anti-starvation bound of the serve scheduler's priority lanes: "
+         "a bulk-lane (multi-bucket) request that has waited longer than "
+         "STARVE_MS is promoted ahead of the express lane so oversized "
+         "requests cannot be deferred forever. A last-resort guardrail, "
+         "deliberately far above normal drain times — too small and "
+         "promoted bulk trumps the express lane it exists to protect. "
+         "Promotions are counted on the daemon summary; rides latency "
+         "records as 'starve_ms'.")
+_declare("TSNE_SERVE_POLL_MAX_MS", "float", 1000.0,
+         "Ceiling of the embed daemon's adaptive spool-poll backoff: the "
+         "poll interval starts at TSNE_SERVE_TICK_S after any work and "
+         "doubles each empty scan up to POLL_MAX_MS, so an idle daemon "
+         "stops burning CPU. The interval in effect at claim time rides "
+         "latency records as 'poll_ms'.")
+
 # ---- caches ----------------------------------------------------------------
 _declare("TSNE_ARTIFACTS", "bool", True,
          "Prepare-artifact cache (utils/artifacts.py) on/off for bench/CLI "
