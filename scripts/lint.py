@@ -10,14 +10,23 @@ Usage:
   python scripts/lint.py --json      # machine-readable findings
   python scripts/lint.py ops/knn.py  # explicit targets instead of defaults
   python scripts/lint.py --audit     # graftcheck: the semantic audit tier
+  python scripts/lint.py --conc      # graftrace: concurrency/protocol tier
+  python scripts/lint.py --all       # lint + conc + audit, one exit code
+  python scripts/lint.py --changed   # lint only git-modified .py files
+
+``--all`` is the single CI gate: all three tiers run (each reports even
+when an earlier tier has findings) and the exit code is the worst of
+them.  ``--changed`` is the fast pre-commit loop — the graftlint rules
+over whatever ``git`` says is modified or untracked.
 
 Any extra arguments are passed through (``--rules``, ``--list-rules``,
-``--env-table``, ``--plan``, paths).  No JAX import happens on the lint
-paths; ``--audit`` hands over to graftcheck, which imports JAX (pinned to
-the CPU backend, abstract eval only).
+``--env-table``, ``--plan``, ``--suppressions``, paths).  No JAX import
+happens on the lint/conc paths; ``--audit`` hands over to graftcheck,
+which imports JAX (pinned to the CPU backend, abstract eval only).
 """
 
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -25,15 +34,52 @@ sys.path.insert(0, REPO)
 
 DEFAULT_TARGETS = ["tsne_flink_tpu", "bench.py", "scripts"]
 
+#: modes that bring their own target set — no DEFAULT_TARGETS appended
+SELF_TARGETING = ("--list-rules", "--env-table", "--audit", "--conc",
+                  "--suppressions")
+
+
+def _changed_files() -> list:
+    """Tracked-modified + untracked ``.py`` files inside the lint target
+    set, repo-relative.  Scoped to DEFAULT_TARGETS on purpose: fixture
+    files under tests/ carry seeded violations by design and must never
+    fail the pre-commit loop."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        got = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                             text=True, check=False)
+        out.update(line.strip() for line in got.stdout.splitlines()
+                   if line.strip())
+    scoped = tuple(t + os.sep for t in DEFAULT_TARGETS if not
+                   t.endswith(".py"))
+    return sorted(f for f in out
+                  if f.endswith(".py") and os.path.exists(f)
+                  and (f.startswith(scoped) or f in DEFAULT_TARGETS))
+
 
 def main(argv=None) -> int:
     from tsne_flink_tpu.analysis.__main__ import main as lint_main
 
     args = list(sys.argv[1:] if argv is None else argv)
     os.chdir(REPO)  # targets and finding paths are repo-relative
+
+    if "--all" in args:
+        passthrough = [a for a in args if a != "--all"]
+        worst = 0
+        for tier in (DEFAULT_TARGETS, ["--conc"], ["--audit"]):
+            worst = max(worst, lint_main(tier + passthrough))
+        return worst
+
+    if "--changed" in args:
+        files = _changed_files()
+        if not files:
+            print("graftlint: no changed .py files")
+            return 0
+        return lint_main(files + [a for a in args if a != "--changed"])
+
     if not any(not a.startswith("-") for a in args) \
-            and "--list-rules" not in args and "--env-table" not in args \
-            and "--audit" not in args:
+            and not any(a in args for a in SELF_TARGETING):
         args += DEFAULT_TARGETS
     return lint_main(args)
 

@@ -1,0 +1,17 @@
+"""determinism-audit fixture: two order-sensitive floating reductions
+the auditor must flag at exactly these lines — an unordered scatter-add
+(the lowering of an unsorted ``.at[].add``) and a float psum over the
+mesh axis that does not route through ``_mesh_sum``.  Imported and
+traced by tests/test_audit.py (unlike the lint fixtures, provenance
+comes from ``make_jaxpr`` source info, so the functions must be real)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def unsorted_scatter(y, idx, v):
+    return y.at[idx].add(v)              # VIOLATION: unordered scatter-add
+
+
+def mesh_float_psum(x, axis_name):
+    return lax.psum(x, axis_name)        # VIOLATION: float psum off-registry

@@ -1,0 +1,54 @@
+"""conc-lock fixture: a leaked bare acquire, an order cycle between the
+request and swap lock classes, and a blocking call under a held lock —
+plus clean and suppressed twins.  Parsed by the analyzer, never
+imported."""
+
+import time
+
+from tsne_flink_tpu.utils.locks import FileLock
+
+
+def claim_no_release(req_path):
+    lock = FileLock(req_path + ".lock")
+    lock.acquire()                       # VIOLATION: conc-lock-release
+    return 1
+
+
+def swap_then_claim(req_path, swap_path):
+    with FileLock(swap_path + ".lock"):
+        with FileLock(req_path + ".lock"):    # VIOLATION: conc-lock-order
+            return 1
+
+
+def claim_then_swap(req_path, swap_path):
+    with FileLock(req_path + ".lock"):
+        with FileLock(swap_path + ".lock"):   # VIOLATION: conc-lock-order
+            return 2
+
+
+def hold_across_sleep(swap_path):
+    with FileLock(swap_path + ".lock"):
+        time.sleep(0.01)                 # VIOLATION: conc-lock-blocking
+        return 3
+
+
+def clean_handoff(req_path):
+    lock = FileLock(req_path + ".lock")
+    lock.acquire()
+    return lock                          # escape: release moves to caller
+
+
+def clean_try_finally(req_path):
+    lock = FileLock(req_path + ".lock")
+    lock.acquire()
+    try:
+        return 4
+    finally:
+        lock.release()
+
+
+def suppressed_sleep(swap_path):
+    with FileLock(swap_path + ".lock"):
+        # graftlint: disable=conc-lock-blocking -- fixture: declared site
+        time.sleep(0.01)
+        return 5

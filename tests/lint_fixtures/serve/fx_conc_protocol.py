@@ -1,0 +1,61 @@
+"""conc-protocol fixture: seeded bypass / rmw / tmp violations against
+the spool-result path class, plus clean and suppressed twins.  Parsed by
+the analyzer, never imported."""
+
+import os
+import tempfile
+
+from tsne_flink_tpu.utils.io import atomic_write
+
+RES_SUFFIX = ".res.npz"
+
+
+def bypass_result(spool, rid):
+    res = os.path.join(spool, rid + RES_SUFFIX)
+    with open(res, "w") as f:            # VIOLATION: conc-protocol-bypass
+        f.write("{}")
+
+
+def refresh_result(spool, rid):          # VIOLATION: conc-protocol-rmw
+    res = os.path.join(spool, rid + RES_SUFFIX)
+    if os.path.exists(res):
+        return None
+    atomic_write(res, lambda tmp: None)
+    return res
+
+
+def tmp_no_rename(payload):
+    fd, tmp = tempfile.mkstemp()         # VIOLATION: conc-protocol-tmp
+    os.write(fd, payload)
+    os.close(fd)
+    return tmp
+
+
+def tmp_no_cleanup(path, payload):
+    fd, tmp = tempfile.mkstemp()         # VIOLATION: conc-protocol-tmp
+    os.write(fd, payload)
+    os.close(fd)
+    os.replace(tmp, path)
+
+
+def clean_result(spool, rid):
+    res = os.path.join(spool, rid + RES_SUFFIX)
+    atomic_write(res, lambda tmp: None)
+
+
+def clean_tmp(path, payload):
+    fd, tmp = tempfile.mkstemp()
+    try:
+        os.write(fd, payload)
+        os.close(fd)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def suppressed_bypass(spool, rid):
+    res = os.path.join(spool, rid + RES_SUFFIX)
+    # graftlint: disable=conc-protocol-bypass -- fixture: suppressed twin
+    with open(res, "w") as f:
+        f.write("{}")

@@ -1,0 +1,59 @@
+"""conc-tick fixture: a daemon-like module (REQ_SUFFIX + RES_SUFFIX
+constants) whose tick breaks every state-machine invariant once — two
+terminals from one function, an unbound claim, a dropped dispatch
+handle, a request deleted before its terminal — plus suppressed twins.
+Parsed by the analyzer, never imported."""
+
+import os
+
+from tsne_flink_tpu.serve.transform import dispatch_bucket
+from tsne_flink_tpu.utils.io import atomic_write
+from tsne_flink_tpu.utils.locks import FileLock
+
+REQ_SUFFIX = ".req.npz"
+RES_SUFFIX = ".res.npz"
+ERR_SUFFIX = ".err.json"
+
+
+def _noop(tmp):
+    return tmp
+
+
+def both_terminals(spool, rid, req_path, lock):  # VIOLATION: two terminals
+    atomic_write(os.path.join(spool, rid + RES_SUFFIX), _noop)
+    atomic_write(os.path.join(spool, rid + ERR_SUFFIX), _noop)
+    os.remove(req_path)
+    lock.release()
+
+
+def claim_unbound(spool, name):
+    req = os.path.join(spool, name)
+    lock = FileLock(req + ".lock")
+    if not lock.acquire(timeout_s=0.0):  # VIOLATION: conc-tick-binding
+        return None
+    return lock
+
+
+def drop_dispatch(model, q):
+    dispatch_bucket(model, q)            # VIOLATION: conc-tick-buffer
+    return None
+
+
+def delete_before_terminal(spool, rid, req_path, lock):
+    os.remove(req_path)                  # VIOLATION: conc-tick-protocol
+    atomic_write(os.path.join(spool, rid + RES_SUFFIX), _noop)
+    lock.release()
+
+
+def clean_finish(spool, rid, req_path, lock):
+    atomic_write(os.path.join(spool, rid + RES_SUFFIX), _noop)
+    os.remove(req_path)
+    lock.release()
+
+
+# graftlint: disable=conc-tick-terminal -- fixture: suppressed twin
+def suppressed_double(spool, rid, req_path, lock):
+    atomic_write(os.path.join(spool, rid + RES_SUFFIX), _noop)
+    atomic_write(os.path.join(spool, rid + ERR_SUFFIX), _noop)
+    os.remove(req_path)
+    lock.release()

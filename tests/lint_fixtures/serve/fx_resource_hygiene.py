@@ -1,0 +1,40 @@
+"""resource-hygiene fixture for the serve/ scope (ISSUE 18 satellite:
+the rule now covers serve/ because the daemon holds claim locks and the
+sched tick owns tempfiles): leaked claim-lock and spool-tempfile
+acquisitions, plus the clean and suppressed twins."""
+
+import os
+import tempfile
+import threading
+
+CLAIM = threading.Lock()
+
+
+def leaky_claim():
+    CLAIM.acquire()                      # VIOLATION: no release path
+    return 1
+
+
+def leaky_spool_tmp():
+    fd, tmp = tempfile.mkstemp()         # VIOLATION: no finally in scope
+    return fd, tmp
+
+
+def clean_claim():
+    with CLAIM:
+        return 2
+
+
+def clean_spool_tmp():
+    fd, tmp = tempfile.mkstemp()
+    try:
+        return fd
+    finally:
+        os.close(fd)
+        os.unlink(tmp)
+
+
+def suppressed_handoff():
+    # graftlint: disable=resource-hygiene -- fixture: claim hand-off twin
+    CLAIM.acquire()
+    return CLAIM

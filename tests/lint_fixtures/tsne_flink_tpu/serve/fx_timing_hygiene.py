@@ -1,0 +1,23 @@
+"""timing-hygiene fixture for the serve/ scope (ISSUE 18 satellite:
+sched.py clocks deadlines and promotions — raw clock reads there dodge
+the obs/timing shim like anywhere else in the package)."""
+
+import time
+from time import monotonic
+
+
+def deadline_sample():
+    now = time.monotonic()               # VIOLATION: time.monotonic()
+    t0 = time.perf_counter()             # VIOLATION: time.perf_counter()
+    t1 = monotonic()                     # VIOLATION: imported name
+    return now, t0, t1
+
+
+def not_timing():
+    time.sleep(0.0)  # not a clock read: never flagged
+    return 0
+
+
+def deliberate_clock():
+    # graftlint: disable=timing-hygiene -- fixture: deliberate raw clock
+    return time.monotonic()

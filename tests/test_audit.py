@@ -2,11 +2,14 @@
 for the semantic audit tier:
 
 * the REPO IS AUDIT-CLEAN: ``python -m tsne_flink_tpu.analysis --audit``
-  exits 0 under JAX_PLATFORMS=cpu — all four analyzers, no device, no
+  exits 0 under JAX_PLATFORMS=cpu — all five analyzers, no device, no
   data (abstract eval only), same JSON schema family as graftlint;
 * the ANALYZERS FIRE: seeded violations (an f64 upcast, an f32 matmul in
   the bf16 path, a per-segment recompile schedule, a dead mesh axis, an
-  over-budget plan) are each detected;
+  over-budget plan, an unblessed floating reduction) are each detected;
+* the DETERMINISM CONTRACT IS PINNED: the real optimize (mesh 1 AND 4)
+  and transform programs carry zero unblessed order-sensitive floating
+  reductions — the static side of the mesh bit-identity tests;
 * the 1M OOM REGRESSION: the committed pre-fix plan (materialized band
   padding + sorted hub-width assembly) is statically flagged against the
   15.75 G budget the chip actually enforced, and the committed blocks fix
@@ -38,7 +41,7 @@ def fixture_plan(name: str) -> PlanConfig:
 # ---- the repo is audit-clean (the acceptance invocation) -------------------
 
 def test_repo_audit_clean_subprocess():
-    """All four analyzers over the repo's representative plans, in a fresh
+    """All five analyzers over the repo's representative plans, in a fresh
     CPU-pinned process with no data: exit 0, graftlint-family JSON."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     r = subprocess.run(
@@ -52,9 +55,10 @@ def test_repo_audit_clean_subprocess():
     assert payload["counts"] == {}
     assert set(payload["analyzers"]) == set(ANALYZERS)
     audit = payload["audit"]
-    for section in ("hbm", "dtype", "compile", "sharding"):
+    for section in ("hbm", "dtype", "compile", "sharding", "determinism"):
         assert section in audit, f"missing analyzer section '{section}'"
     assert audit["sharding"]["ok"] is True
+    assert audit["determinism"]["ok"] is True
     # every registered op was traced or explicitly declared-only
     assert all("traced" in rep for rep in audit["dtype"].values())
 
@@ -287,6 +291,100 @@ def test_sharding_audit_detects_dead_axis():
     assert len(findings) == 1 and findings[0].rule == "sharding-contract"
 
 
+# ---- determinism-audit ------------------------------------------------------
+
+def _determinism_fixture():
+    import importlib.util
+    path = os.path.join(FIXTURES, "fx_determinism.py")
+    spec = importlib.util.spec_from_file_location("fx_determinism", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    lines = {}
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if "VIOLATION" in line:
+                lines[line.split("VIOLATION:")[1].strip()] = i
+    return mod, lines
+
+
+def test_determinism_auditor_fires_on_fixture():
+    """Both seeded reductions are flagged at the fixture's exact marked
+    lines — trace provenance resolves through make_jaxpr source info."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tsne_flink_tpu.analysis.audit.determinism import scan_jaxpr
+    from tsne_flink_tpu.parallel.mesh import make_mesh
+    from tsne_flink_tpu.utils.compat import shard_map
+
+    fx, marked = _determinism_fixture()
+
+    scatter = jax.make_jaxpr(fx.unsorted_scatter)(
+        jax.ShapeDtypeStruct((8, 4), jnp.float32),
+        jax.ShapeDtypeStruct((3,), jnp.int32),
+        jax.ShapeDtypeStruct((3, 4), jnp.float32))
+    findings, blessed = scan_jaxpr(scatter, "fixture-scatter")
+    assert blessed == []
+    assert [f.rule for f in findings] == ["determinism-audit"]
+    assert findings[0].line == marked["unordered scatter-add"]
+    assert findings[0].path.endswith("audit_fixtures/fx_determinism.py")
+
+    mesh = make_mesh(1)
+    fn = shard_map(lambda x: fx.mesh_float_psum(x, "points"), mesh=mesh,
+                   in_specs=(P("points"),), out_specs=P())
+    psum = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8,), jnp.float32))
+    findings, _ = scan_jaxpr(psum, "fixture-psum")
+    assert [f.rule for f in findings] == ["determinism-audit"]
+    assert findings[0].line == marked["float psum off-registry"]
+    assert "psum" in findings[0].message
+
+
+def test_determinism_blessed_site_not_flagged():
+    """The same psum routed through a registered site stays silent: the
+    registry, not luck, is what keeps the repo clean."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tsne_flink_tpu.analysis.audit.determinism import scan_jaxpr
+    from tsne_flink_tpu.models.tsne import _global_mean
+    from tsne_flink_tpu.parallel.mesh import AXIS, make_mesh
+    from tsne_flink_tpu.utils.compat import shard_map
+
+    mesh = make_mesh(1)
+    fn = shard_map(lambda y: _global_mean(y, AXIS), mesh=mesh,
+                   in_specs=(P("points"),), out_specs=P())
+    jaxpr = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 2), jnp.float32))
+    findings, blessed = scan_jaxpr(jaxpr, "blessed-mean")
+    assert findings == []
+    assert any("_global_mean" in b for b in blessed)
+
+
+def test_determinism_repo_programs_pinned_clean():
+    """The real programs the bit-identity tests run dynamically carry
+    ZERO unblessed reductions statically — optimize at mesh 1 and mesh 4
+    (tier-1 forces 8 host devices, so mesh 4 must trace, not skip) and
+    every transform stage for both repulsion backends."""
+    from tsne_flink_tpu.analysis.audit.determinism import audit_determinism
+
+    findings, report = audit_determinism()
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert report["ok"] is True
+    programs = report["programs"]
+    for label in ("optimize[mesh1]", "optimize[mesh4]",
+                  "transform[exact].knn", "transform[exact].init",
+                  "transform[exact].optimize", "transform[fft].knn",
+                  "transform[fft].init", "transform[fft].optimize"):
+        assert label in programs, sorted(programs)
+        assert programs[label].get("unblessed") == 0, (label,
+                                                       programs[label])
+    # the mesh programs actually exercised the blessed registry — the
+    # mean's count psum is the one permitted float psum in the trace
+    assert any("_global_mean" in b
+               for b in programs["optimize[mesh4]"]["blessed_sites"])
+
+
 # ---- CLI --auditPlan + checkpoint metadata (satellites) ---------------------
 
 def _tiny_csv(tmp_path, n=40, d=6):
@@ -320,11 +418,14 @@ def test_cli_audit_plan_gate_and_checkpoint_payload(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "auditPlan: peak HBM est" in out
+    assert "auditPlan: determinism:" in out
     payload = ckpt.load_prepare(ck)
     assert payload is not None and "audit" in payload
     audit = json.loads(str(payload["audit"]))
     assert audit["peak_hbm_est"] > 0 and audit["compile_count"] >= 2
     assert audit["ok"] is True
+    # the launch-gate determinism cross-section rode into the checkpoint
+    assert audit["determinism"]["unblessed"] == 0
 
     # resume with a divergent config: the embedded audit flags the drift
     rc = main(_cli_args(tmp_path, inp,

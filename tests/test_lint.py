@@ -110,6 +110,26 @@ def test_policy_recorded_fires_in_serve_fixture():
                              f"violations at {sorted(expected)}")
 
 
+@pytest.mark.parametrize("rule,fixture", [
+    ("resource-hygiene", os.path.join("serve", "fx_resource_hygiene.py")),
+    ("timing-hygiene", os.path.join("tsne_flink_tpu", "serve",
+                                    "fx_timing_hygiene.py")),
+])
+def test_hygiene_rules_fire_in_serve_fixtures(rule, fixture):
+    """graftrace extension (ISSUE 18 satellite): resource-hygiene now
+    scans serve/ too — the claim/spool locks and result tempfiles live
+    there — and timing-hygiene keeps sched.py's deadline clocks on the
+    obs/timing shim.  Suppressed twins (the deliberate claim hand-off)
+    stay silent."""
+    findings = run_rule(rule, fixture)
+    assert findings, f"{rule} found nothing in the serve fixture"
+    assert {f.rule for f in findings} == {rule}
+    got = {f.line for f in findings}
+    expected = violation_lines(fixture)
+    assert got == expected, (f"findings at {sorted(got)}, seeded "
+                             f"violations at {sorted(expected)}")
+
+
 def test_suppression_comment_silences(tmp_path):
     src = ("import os\n"
            "A = os.environ.get('TSNE_FORCE_CPU', '')\n"

@@ -44,12 +44,25 @@ def main(argv=None) -> int:
                    help="(--audit) audit these PlanConfig JSON file(s) "
                         "instead of the built-in representative plans")
     p.add_argument("--analyzers", default=None,
-                   help="(--audit) comma-separated subset of the four "
+                   help="(--audit) comma-separated subset of the five "
                         "analyzers to run")
+    p.add_argument("--conc", action="store_true",
+                   help="run graftrace, the static concurrency/protocol "
+                        "tier: protocol bypass/rmw/tmp, lock discipline "
+                        "and the graftsched tick state machine over "
+                        "runtime//serve//utils/ (stdlib-only, no JAX)")
+    p.add_argument("--suppressions", action="store_true",
+                   help="print the suppression ledger: every 'graftlint: "
+                        "disable' under the targets with file:line, "
+                        "rules and rationale")
     args = p.parse_args(argv)
 
     if args.audit:
         return _audit(args)
+    if args.conc:
+        return _conc(args)
+    if args.suppressions:
+        return _suppressions(args)
     if args.env_table:
         # stdlib-only import: the registry is deliberately JAX-free
         from tsne_flink_tpu.utils.env import env_table_markdown
@@ -70,6 +83,48 @@ def main(argv=None) -> int:
     else:
         print(core.render_human(findings, n_files))
     return 1 if findings else 0
+
+
+def _conc(args) -> int:
+    """The graftrace entry — stdlib-only like the lint paths (pinned by
+    tests/test_conc.py): no JAX import may happen here."""
+    from tsne_flink_tpu.analysis.conc import (render_conc_human,
+                                              render_conc_json, run_conc)
+    findings, report = run_conc(paths=args.paths or None)
+    if args.json:
+        print(render_conc_json(findings, report))
+    else:
+        print(render_conc_human(findings, report))
+    return 1 if findings else 0
+
+
+def _suppressions(args) -> int:
+    """The suppression ledger: every disable comment is an auditable,
+    deliberate exception — tier-1 pins the count."""
+    import json
+
+    if args.paths:
+        paths, root = args.paths, None
+    else:
+        # default to the source tree the package lives in (cwd-independent;
+        # bench.py/scripts exist only in a repo checkout, not a wheel)
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        root = os.path.dirname(pkg)
+        paths = [p for p in (pkg, os.path.join(root, "bench.py"),
+                             os.path.join(root, "scripts"))
+                 if os.path.exists(p)]
+    rows = core.collect_suppressions(paths, root=root)
+    if args.json:
+        print(json.dumps({"suppressions": rows, "count": len(rows)},
+                         indent=2))
+    else:
+        for r in rows:
+            why = r["rationale"] or "(no rationale)"
+            scope = "[file] " if r["scope"] == "file" else ""
+            print(f"{r['path']}:{r['line']}: {scope}"
+                  f"{','.join(r['rules'])} -- {why}")
+        print(f"graftlint: {len(rows)} suppression(s)")
+    return 0
 
 
 def _audit(args) -> int:

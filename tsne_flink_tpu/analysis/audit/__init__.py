@@ -4,7 +4,7 @@ Where graftlint (:mod:`tsne_flink_tpu.analysis.rules`) proves SYNTACTIC
 contracts with ``ast`` alone, graftcheck proves SEMANTIC ones by tracing
 the real pipeline abstractly — ``jax.eval_shape`` / ``jax.make_jaxpr``
 over ShapeDtypeStructs, on the CPU backend, with no data and no device
-computation.  Four analyzers, one report format shared with graftlint:
+computation.  Five analyzers, one report format shared with graftlint:
 
 * ``hbm-footprint``     (:mod:`.hbm`)      — per-stage peak-HBM estimates
   for a :class:`~.plan.PlanConfig`, gated against the device budget; the
@@ -19,6 +19,10 @@ computation.  Four analyzers, one report format shared with graftlint:
 * ``sharding-contract`` (:mod:`.sharding`) — the shard_map programs
   traced against the mesh spec; every collective's axis name must be a
   live mesh axis.
+* ``determinism-audit`` (:mod:`.determinism`) — the optimize (mesh 1
+  and 4) and transform jaxprs scanned for order-sensitive floating
+  reductions off the blessed-site registry (``_mesh_sum``, spectral Z,
+  float-exact counts): the mesh bit-identity contract, statically.
 
 Entry points: ``python -m tsne_flink_tpu.analysis --audit`` (and
 ``scripts/lint.py --audit``) run the full repo audit; the CLI's
@@ -40,7 +44,7 @@ from tsne_flink_tpu.analysis.audit.plan import (  # noqa: F401
     HBM_BUDGET_BYTES, PlanConfig, bench_plan)
 
 ANALYZERS = ("hbm-footprint", "dtype-contract", "compile-audit",
-             "sharding-contract")
+             "sharding-contract", "determinism-audit")
 
 
 def default_plans() -> list:
@@ -89,6 +93,11 @@ def run_audit(plans=None, analyzers=None) -> tuple[list, dict]:
         f, rep = sharding_audit.audit_sharding()
         findings.extend(f)
         report["sharding"] = rep
+    if "determinism-audit" in selected:
+        from tsne_flink_tpu.analysis.audit import determinism as det_audit
+        f, rep = det_audit.audit_determinism()
+        findings.extend(f)
+        report["determinism"] = rep
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, report
 
@@ -114,6 +123,13 @@ def render_audit_human(findings, report) -> str:
             + ("(no budget)" if rep["hbm_budget"] is None else
                f"vs {round(rep['hbm_budget'] / (1 << 30), 2)} GiB budget "
                f"-> {'ok' if rep['ok'] else 'PREDICTED OOM'}"))
+    det = report.get("determinism")
+    if det:
+        unblessed = sum(p.get("unblessed", 0)
+                        for p in det["programs"].values())
+        lines.append(
+            f"graftcheck: determinism: {unblessed} unblessed reduction(s) "
+            f"across {len(det['programs'])} traced program(s)")
     lines.append(f"graftcheck: {len(findings)} finding(s) across "
                  f"{len(report.get('plans', {}))} plan(s)")
     return "\n".join(lines)

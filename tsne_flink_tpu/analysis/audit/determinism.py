@@ -1,0 +1,254 @@
+"""determinism-audit — prove the mesh bit-identity contract over jaxprs.
+
+The repo's central numerics contract — mesh 1 == mesh 4 **bit-identical**
+(tests/test_mesh.py), batch-split-identical serving (tests/test_serve.py)
+— holds because every order-sensitive floating reduction is routed
+through a FIXED-ORDER site: ``models/tsne._mesh_sum`` gathers the
+per-row partials and reduces them in one order on every mesh width, the
+FFT backend's Z is a replicated spectral global, and per-row
+``row_z``/``row_loss`` partials reduce within a row (no cross-row
+grouping to vary).  That routing is a convention; this analyzer makes it
+a checked property: trace the REAL optimize and transform programs via
+``jax.make_jaxpr`` on ShapeDtypeStructs and flag every order-sensitive
+floating reduction that is not on the blessed-site registry.
+
+Order-sensitive shapes scanned for:
+
+* ``psum`` over the mesh axis with floating operands — per-shard partial
+  sums regroup with mesh width, so a float psum breaks mesh identity
+  unless its operand is exactly representable (the blessed count sites);
+* ``scatter-add`` without BOTH ``indices_are_sorted`` and
+  ``unique_indices`` — an unordered scatter (the lowering of unordered
+  ``segment_sum``) lets XLA add colliding rows in any order.
+
+Everything runs abstractly on the CPU backend — no data, no device
+computation; mesh-4 programs trace on 4 host devices when the process
+has them (tier-1 forces 8 via ``--xla_force_host_platform_device_count``)
+and are recorded as skipped otherwise.
+"""
+
+from __future__ import annotations
+
+from tsne_flink_tpu.analysis.core import Finding
+
+RULE = "determinism-audit"
+
+#: (function_name, file suffix) -> rationale.  A flagged reduction is
+#: blessed when ANY frame of its trace provenance matches a row — the
+#: registry names the fixed-order sites the bit-identity contract is
+#: BUILT on, so a new reduction must either route through one of these
+#: or argue its way onto the list.
+BLESSED_SITES = {
+    ("_mesh_sum", "models/tsne.py"):
+        "THE fixed-order reduction: all_gather the per-row partials, "
+        "reduce once in one order on every mesh width",
+    ("_global_mean", "models/tsne.py"):
+        "psum of an integer-valued row count (float-exact under any "
+        "grouping); the mean's numerator rides _mesh_sum",
+    ("_telemetry_row", "models/tsne.py"):
+        "psum of gains/valid counts — integer-valued, float-exact; the "
+        "norm partials ride _mesh_sum",
+    ("fft_field_repulsion", "ops/repulsion_fft.py"):
+        "spectral Z: the field is a replicated global computed from the "
+        "full embedding — no per-shard grouping exists to vary",
+}
+
+
+def _iter_eqns(jaxpr):
+    from tsne_flink_tpu.analysis.audit.dtype import _iter_jaxprs
+    for j in _iter_jaxprs(jaxpr):
+        for eqn in j.eqns:
+            yield eqn
+
+
+def _is_float(v) -> bool:
+    import jax.numpy as jnp
+    dt = getattr(getattr(v, "aval", None), "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jnp.floating)
+
+
+def _repo_frames(eqn):
+    """(file, line, function) provenance rows of one eqn, innermost
+    first, restricted to files under the repo tree (or the tests/
+    fixture tree — fixture violations must resolve to their exact
+    line)."""
+    tb = getattr(eqn.source_info, "traceback", None)
+    out = []
+    if tb is None:
+        return out
+    for fr in tb.frames:
+        f = fr.file_name.replace("\\", "/")
+        if "tsne_flink_tpu/" in f:
+            out.append(("tsne_flink_tpu/" + f.split("tsne_flink_tpu/")[-1],
+                        fr.line_num, fr.function_name))
+        elif "/tests/" in f or f.startswith("tests/"):
+            out.append(("tests/" + f.split("/tests/")[-1].lstrip("/"),
+                        fr.line_num, fr.function_name))
+    return out
+
+
+def _blessed_by(frames):
+    for path, _line, func in frames:
+        for (bfunc, bfile), why in BLESSED_SITES.items():
+            if func == bfunc and path.endswith(bfile):
+                return f"{bfunc} ({bfile})", why
+    return None
+
+
+def scan_jaxpr(jaxpr, label: str) -> tuple[list, list]:
+    """Scan one traced program; returns (findings, blessed_site_names).
+    A finding lands at the innermost repo frame of the offending eqn —
+    for a seeded fixture that is the fixture's exact line."""
+    findings: list = []
+    blessed: list = []
+    for eqn in _iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        offense = None
+        if name == "psum" and any(_is_float(v) for v in eqn.invars):
+            offense = ("float psum over the mesh axis: per-shard "
+                       "partials regroup with mesh width")
+        elif name == "scatter-add":
+            if not (eqn.params.get("indices_are_sorted")
+                    and eqn.params.get("unique_indices")):
+                offense = ("unordered scatter-add (unsorted or "
+                           "non-unique indices): XLA may add colliding "
+                           "rows in any order")
+        if offense is None:
+            continue
+        frames = _repo_frames(eqn)
+        hit = _blessed_by(frames)
+        if hit is not None:
+            blessed.append(hit[0])
+            continue
+        path, line = (frames[0][0], frames[0][1]) if frames \
+            else (f"trace:{label}", 1)
+        findings.append(Finding(
+            RULE, path, line, 0,
+            f"[{label}] {offense} — not on the blessed-site registry "
+            "(route through _mesh_sum or add the site with a rationale)"))
+    return findings, sorted(set(blessed))
+
+
+def _optimize_jaxpr(n_devices: int):
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+    from tsne_flink_tpu.parallel.mesh import (AXIS, make_mesh, pspec,
+                                              rspec, state_pspec)
+    from tsne_flink_tpu.utils.compat import shard_map
+
+    mesh = make_mesh(n_devices)
+    n, k = 8 * n_devices, 4
+    cfg = TsneConfig(iterations=4, repulsion="exact", row_chunk=8)
+    state = TsneState(y=jax.ShapeDtypeStruct((n, 2), jnp.float32),
+                     update=jax.ShapeDtypeStruct((n, 2), jnp.float32),
+                     gains=jax.ShapeDtypeStruct((n, 2), jnp.float32))
+    sspec = state_pspec()
+    fn = shard_map(
+        lambda st, ji, jv: optimize(st, ji, jv, cfg, axis_name=AXIS),
+        mesh=mesh, in_specs=(sspec, pspec(), pspec()),
+        out_specs=(sspec, rspec()))
+    return jax.make_jaxpr(fn)(
+        state, jax.ShapeDtypeStruct((n, 2 * k), jnp.int32),
+        jax.ShapeDtypeStruct((n, 2 * k), jnp.float32))
+
+
+def _transform_jaxprs(repulsion: str):
+    """(label, jaxpr) per serve stage of a tiny frozen model — the AOT
+    wrapper is peeled (``._jitted``) so the trace sees the real staged
+    program, cache on or off."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+    from tsne_flink_tpu.serve.model import from_arrays
+    from tsne_flink_tpu.serve.transform import _build_stages
+
+    rng = np.random.default_rng(0)
+    n, d, m, bucket = 64, 6, 2, 8
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((n, m))).astype(np.float32)
+    plan = PlanConfig(n=n, d=d, k=12, backend="cpu", repulsion=repulsion,
+                      name=f"determinism-serve-{repulsion}")
+    model = from_arrays(x, y, plan, perplexity=4.0, learning_rate=100.0)
+    stages = _build_stages(model, bucket, iters=2, eta=0.5)
+    k = model.k
+
+    def peel(f):
+        return getattr(f, "_jitted", f)
+
+    q = jax.ShapeDtypeStruct((bucket, d), jnp.float32)
+    xb = jax.ShapeDtypeStruct((n, d), jnp.float32)
+    yb = jax.ShapeDtypeStruct((n, m), jnp.float32)
+    dist = jax.ShapeDtypeStruct((bucket, k), jnp.float32)
+    idx = jax.ShapeDtypeStruct((bucket, k), jnp.int32)
+    p = jax.ShapeDtypeStruct((bucket, k), jnp.float32)
+    y0 = jax.ShapeDtypeStruct((bucket, m), jnp.float32)
+    rep = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in stages.rep_args)
+    tag = f"transform[{model.repulsion}]"
+    return [
+        (f"{tag}.knn", jax.make_jaxpr(peel(stages.knn))(q, xb)),
+        (f"{tag}.init", jax.make_jaxpr(peel(stages.init))(dist, idx, yb)),
+        (f"{tag}.optimize",
+         jax.make_jaxpr(peel(stages.optimize))(y0, idx, p, yb, *rep)),
+    ]
+
+
+def audit_determinism() -> tuple[list, dict]:
+    """Trace the real optimize (mesh 1 and 4) and transform programs and
+    scan each for unblessed order-sensitive floating reductions."""
+    import jax
+
+    findings: list = []
+    programs: dict = {}
+
+    def scan(label, thunk):
+        try:
+            jaxpr = thunk()
+        except Exception as e:  # noqa: BLE001 — a trace error IS a finding
+            findings.append(Finding(
+                RULE, f"trace:{label}", 1, 0,
+                f"program '{label}' fails to trace: "
+                f"{type(e).__name__}: {e}"))
+            programs[label] = {"error": f"{type(e).__name__}: {e}"}
+            return
+        got, blessed = scan_jaxpr(jaxpr, label)
+        findings.extend(got)
+        programs[label] = {"unblessed": len(got),
+                           "blessed_sites": blessed}
+
+    n_dev = len(jax.devices())
+    scan("optimize[mesh1]", lambda: _optimize_jaxpr(1))
+    if n_dev >= 4:
+        scan("optimize[mesh4]", lambda: _optimize_jaxpr(4))
+    else:
+        programs["optimize[mesh4]"] = {
+            "skipped": f"needs 4 devices, have {n_dev} (tier-1 forces 8 "
+                       "via --xla_force_host_platform_device_count)"}
+
+    for repulsion in ("exact", "fft"):
+        try:
+            staged = _transform_jaxprs(repulsion)
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                RULE, f"trace:transform[{repulsion}]", 1, 0,
+                f"transform stages ({repulsion}) fail to build/trace: "
+                f"{type(e).__name__}: {e}"))
+            continue
+        for label, jaxpr in staged:
+            got, blessed = scan_jaxpr(jaxpr, label)
+            findings.extend(got)
+            programs[label] = {"unblessed": len(got),
+                               "blessed_sites": blessed}
+
+    report = {
+        "programs": programs,
+        "blessed_registry": {f"{fn} ({path})": why
+                             for (fn, path), why in BLESSED_SITES.items()},
+        "devices": n_dev,
+        "ok": not findings,
+    }
+    return findings, report

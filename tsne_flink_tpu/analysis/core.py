@@ -218,6 +218,61 @@ def load_project(paths, root: str | None = None) -> Project:
     return Project(modules)
 
 
+def collect_suppressions(paths, root: str | None = None) -> list[dict]:
+    """The suppression ledger: every ``graftlint: disable`` comment under
+    ``paths`` with its rules, scope and rationale (the text after ``--``,
+    plus any continuation comment lines below a standalone disable).
+    ``python -m tsne_flink_tpu.analysis --suppressions`` renders this;
+    tier-1 pins the count so a new suppression is a deliberate diff."""
+    root = root or os.getcwd()
+    rows: list[dict] = []
+    for path in iter_py_files(paths):
+        display = os.path.relpath(path, root)
+        if display.startswith(".."):
+            display = path
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        lines = source.splitlines()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except tokenize.TokenError:
+            continue
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rationale = ""
+            rest = tok.string[m.end():]
+            if "--" in rest:
+                rationale = rest.split("--", 1)[1].strip()
+            standalone = not lines[tok.start[0] - 1][:tok.start[1]].strip()
+            if standalone:
+                # a multi-line rationale continues on the comment lines
+                # directly below (the repo convention)
+                nxt = tok.start[0] + 1
+                while nxt <= len(lines):
+                    stripped = lines[nxt - 1].strip()
+                    if (not stripped.startswith("#")
+                            or SUPPRESS_RE.search(stripped)):
+                        break
+                    rationale = (rationale + " "
+                                 + stripped.lstrip("#").strip()).strip()
+                    nxt += 1
+            rows.append({
+                "path": display, "line": tok.start[0],
+                "rules": sorted(r.strip()
+                                for r in m.group("rules").split(",")
+                                if r.strip()),
+                "scope": "file" if m.group("whole_file") else "line",
+                "rationale": rationale,
+            })
+    rows.sort(key=lambda r: (r["path"], r["line"]))
+    return rows
+
+
 def run(paths, root: str | None = None,
         rules: list[str] | None = None) -> tuple[list[Finding], int]:
     """Run (selected) rules over ``paths``; returns (findings, n_files).

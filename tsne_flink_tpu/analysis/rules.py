@@ -936,8 +936,8 @@ def _resource_acquisitions(nodes, tempfile_names: set[str],
 
 
 @rule("resource-hygiene",
-      "locks/semaphores/tempfiles acquired in runtime/ and utils/ are "
-      "released via a context manager or try/finally")
+      "locks/semaphores/tempfiles acquired in runtime/, serve/ and "
+      "utils/ are released via a context manager or try/finally")
 def resource_hygiene(project: Project):
     """A lock or temp resource acquired on a path a fault can interrupt
     (the fleet SIGKILLs jobs; the watchdog os._exit()s on timeout) must
@@ -945,12 +945,14 @@ def resource_hygiene(project: Project):
     context expression, or the enclosing function carries a
     ``try/finally`` that owns the cleanup.  The check is lexical by
     design — a function that acquires and has NO finally anywhere cannot
-    be releasing on its error paths."""
+    be releasing on its error paths.  ``serve/`` is in scope since the
+    daemon grew claim locks and the sched tick (ISSUE 18): a wedged
+    spool lock there stalls every client until the stale-break."""
     findings = []
     for mod in project.modules:
         norm = mod.display.replace(os.sep, "/")
         in_scope = any(f"/{d}/" in norm or norm.startswith(f"{d}/")
-                       for d in ("runtime", "utils"))
+                       for d in ("runtime", "serve", "utils"))
         if not in_scope:
             continue
         tempfile_names = _import_aliases(mod.tree, "tempfile")

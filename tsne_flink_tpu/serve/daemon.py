@@ -303,6 +303,12 @@ class ServeDaemon:
                 pass
             return None
         lock = FileLock(req_path + ".lock")
+        # graftlint: disable=resource-hygiene -- claim hand-off: the
+        # lock deliberately OUTLIVES this function (held claim-to-result
+        # is the spool crash story); it is returned to the caller, every
+        # error path below releases, and abandoned claims are released
+        # by drain/_shutdown_flush's finally or broken by the stale-lock
+        # timeout after a SIGKILL.
         if not lock.acquire(timeout_s=0.0):
             return None
         try:
@@ -385,6 +391,13 @@ class ServeDaemon:
                 out = {"op": "swap", "status": "ok"}
                 try:
                     from tsne_flink_tpu.serve.model import frozen_from_files
+                    # graftlint: disable=conc-lock-blocking -- declared
+                    # site: the swap lock SHOULD cover the model load —
+                    # it serializes concurrent swap requests for the same
+                    # control file (last-writer-wins on the done file
+                    # would otherwise ack a swap that lost the race), and
+                    # request claims use per-request locks, so serving is
+                    # never behind this hold.
                     model = frozen_from_files(
                         spec["model"], spec["input"],
                         perplexity=float(spec.get("perplexity", 10.0)),

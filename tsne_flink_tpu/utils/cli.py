@@ -406,6 +406,23 @@ def _plan_audit_summary(plan, checkpoint_every: int = 0) -> dict:
             "compile_count": plan_compile_count(plan, checkpoint_every)}
 
 
+def _determinism_summary() -> dict:
+    """One-program determinism check for the launch gate: trace the real
+    mesh-1 optimize and count unblessed order-sensitive reductions.  The
+    full multi-mesh/transform sweep lives in ``--audit``; this is the
+    cheap cross-section a launch can afford.  Never raises — a trace
+    failure is reported, not fatal (the gate's job is the OOM refusal)."""
+    try:
+        from tsne_flink_tpu.analysis.audit import determinism as det
+        findings, blessed = det.scan_jaxpr(det._optimize_jaxpr(1),
+                                           "optimize[mesh1]")
+        return {"unblessed": len(findings),
+                "blessed_sites": blessed,
+                "findings": [f.format() for f in findings]}
+    except Exception as e:  # noqa: BLE001 — advisory line, never fatal
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _audit_gate(args, cfg, n: int, assembly: str, neighbors: int):
     """--auditPlan: print the static plan audit and refuse a predicted OOM
     (the 'linter told us at second 4' gate; --auditPlan=warn overrides).
@@ -423,6 +440,16 @@ def _audit_gate(args, cfg, n: int, assembly: str, neighbors: int):
     for stage, terms in rep["stages"].items():
         print(f"# auditPlan:   {stage}: "
               + " ".join(f"{t}={v}" for t, v in terms.items()))
+    det = _determinism_summary()
+    summary["determinism"] = det
+    if "error" in det:
+        print(f"# auditPlan: determinism: audit unavailable ({det['error']})")
+    else:
+        print(f"# auditPlan: determinism: {det['unblessed']} unblessed "
+              "reduction(s) in optimize[mesh1]; blessed sites: "
+              + (", ".join(det["blessed_sites"]) or "none"))
+        for line in det["findings"]:
+            print(f"# auditPlan:   {line}")
     if not rep["ok"]:
         msg = (f"plan predicted to OOM: peak HBM estimate "
                f"{rep['peak_hbm_est_gib']} GiB in the '{rep['peak_stage']}' "
