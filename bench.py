@@ -500,6 +500,25 @@ def main():
                  "peak_stage": _hbm["peak_stage"],
                  "hbm_budget": _hbm["hbm_budget"], "ok": _hbm["ok"],
                  "compile_count": plan_compile_count(_plan, seg)}
+    # graftcomms: the predicted ICI bill for this workload under the
+    # RESOLVED reduce mode, so a measured cross-host slowdown is
+    # diagnosable against what the ring model priced (advisory — a trace
+    # failure must never kill a bench run)
+    try:
+        from tsne_flink_tpu.analysis.audit.comms import plan_comms_report
+        from tsne_flink_tpu.models.tsne import pick_mesh_reduce
+        _com = plan_comms_report(_plan, pick_mesh_reduce())
+        audit_rec["comms"] = {
+            "mode": _com["mode"], "mesh": _com["mesh"],
+            "collectives": len(_com["collectives"]),
+            "unblessed": sum(1 for r in _com["collectives"]
+                             if r["blessed"] is None),
+            "per_iter_bytes": _com["per_iter_bytes"],
+            "per_iter_reduce_bytes": _com["per_iter_reduce_bytes"],
+            "per_run_bytes": _com["per_run_bytes"],
+            "comms_fraction": _com["comms_fraction"]}
+    except Exception as e:  # noqa: BLE001
+        audit_rec["comms"] = {"error": f"{type(e).__name__}: {e}"}
 
     # host-calibration probe (obs/calibrate.py): measured matmul GFLOP/s +
     # cache.host_signature() on every record, so cross-round stage ratios

@@ -24,10 +24,20 @@ trigger, old -> new stride and grid level, grad-norm at decision) plus
 the ladder identities and refresh count — the terminal face of the
 models/autopilot.py policy trace.
 
+``--comms`` (graftcomms satellite): reads either a committed comms
+fixture / ``plan_mode_pair`` JSON (tests/data/comms_1m_v5e8.json), a
+bench record carrying the ``audit.comms`` summary, or a PlanConfig JSON
+(in which case the live ring model runs — the one path that imports
+JAX), and renders the per-collective inventory: primitive, issuing
+function with file:line provenance, per-shard payload and ring-model
+sent bytes, per-iteration vs per-segment, blessed site — the terminal
+face of the comms-audit analyzer.
+
 ``--smoke`` (tier-1, tests/test_obs.py): generates a tiny in-process
 trace with the real tracer, writes it to a temp file, and reports on it —
-plus a synthetic memory table and a synthetic policy table — proving the
-emit -> load -> aggregate loop end to end without JAX.
+plus a synthetic memory table, a synthetic policy table and a synthetic
+comms inventory — proving the emit -> load -> aggregate loop end to end
+without JAX.
 """
 
 from __future__ import annotations
@@ -258,6 +268,71 @@ def render_policy(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def comms_summary(obj: dict) -> dict:
+    """Normalized comms inventory from any of the three input shapes:
+    a ``plan_mode_pair`` fixture ({"canonical", "psum", ...}), a single
+    ``plan_comms_report``, a bench record (its ``audit.comms`` summary
+    block), or a PlanConfig JSON (runs the live model — imports JAX).
+    Returns {"modes": [...], "collapse": float|None}."""
+    if "audit" in obj:  # bench record
+        block = (obj.get("audit") or {}).get("comms")
+        if not block:
+            return {"modes": [], "collapse": None}
+        if "error" in block:
+            return {"modes": [], "collapse": None,
+                    "error": block["error"]}
+        return {"modes": [dict(block, collectives=None)],
+                "collapse": None}
+    if "canonical" in obj and "psum" in obj:  # fixture pair
+        return {"modes": [obj["canonical"], obj["psum"]],
+                "collapse": obj.get("reduce_bytes_collapse")}
+    if "collectives" in obj:  # single report
+        return {"modes": [obj], "collapse": None}
+    if "n" in obj:  # PlanConfig JSON -> live model
+        from tsne_flink_tpu.analysis.audit.comms import plan_mode_pair
+        from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+        pair = plan_mode_pair(PlanConfig(**{
+            k: v for k, v in obj.items()
+            if k in PlanConfig.__dataclass_fields__}))
+        return {"modes": [pair["canonical"], pair["psum"]],
+                "collapse": pair["reduce_bytes_collapse"]}
+    return {"modes": [], "collapse": None}
+
+
+def render_comms(summary: dict) -> str:
+    if summary.get("error"):
+        return f"trace_report: comms audit errored: {summary['error']}"
+    if not summary["modes"]:
+        return "trace_report: no comms block in this input"
+    lines = []
+    for rep in summary["modes"]:
+        frac = rep.get("comms_fraction")
+        lines.append(
+            f"comms [{rep.get('mode', '?')}] mesh {rep.get('mesh', '?')}: "
+            f"{rep.get('per_iter_bytes', '?')} B/iter sent/device, "
+            f"reduce slice {rep.get('per_iter_reduce_bytes', '?')} B"
+            + ("" if frac is None else f", ~{100 * frac:.0f}% of step"))
+        rows = rep.get("collectives")
+        if rows is None:
+            continue
+        w = max((len(r["func"]) for r in rows), default=4) + 2
+        lines.append(f"  {'primitive':<11} {'func':<{w}} "
+                     f"{'payload B':>10} {'sent B':>12} {'hops':>5} "
+                     f"{'when':<13} site")
+        for r in rows:
+            when = "per-iteration" if r.get("per_iteration") else "per-segment"
+            site = r.get("blessed") or "UNBLESSED"
+            lines.append(
+                f"  {r['primitive']:<11} {r['func']:<{w}} "
+                f"{r['payload_bytes']:>10} {r['sent_bytes']:>12} "
+                f"{r.get('hops', 0):>5} {when:<13} "
+                f"{site}  ({r['path']}:{r['line']})")
+    if summary["collapse"] is not None:
+        lines.append(f"reduce-bytes collapse canonical -> psum: "
+                     f"{summary['collapse']:.0f}x")
+    return "\n".join(lines)
+
+
 def _smoke(out_json: bool) -> int:
     """Emit a real (tiny) trace through the tracer and report on it —
     the tier-1 pin that the whole export/report loop works, JAX-free."""
@@ -320,21 +395,54 @@ def _smoke(out_json: bool) -> int:
               and psum["rows"][0]["stride"] == "1->2"
               and psum["rows"][1]["grid"] == "0->1"
               and psum["refreshes"] == 190)
+    # the --comms path, end to end on a synthetic graftcomms mode pair:
+    # one O(N) canonical reduction row collapsing to a scalar psum
+    def _crow(prim, func, payload, sent, hops, per_iter):
+        return {"primitive": prim, "func": func, "path": "models/tsne.py",
+                "line": 165, "payload_bytes": payload, "sent_bytes": sent,
+                "hops": hops, "per_iteration": per_iter,
+                "blessed": f"{func} (models/tsne.py)", "n_scaling": True}
+    crec = {"canonical": {"mode": "canonical", "mesh": 4,
+                          "per_iter_bytes": 3_000_000,
+                          "per_iter_reduce_bytes": 1_500_000,
+                          "comms_fraction": 0.5,
+                          "collectives": [
+                              _crow("all_gather", "_mesh_sum",
+                                    500_000, 1_500_000, 3, True),
+                              _crow("all_gather", "_gradient",
+                                    500_000, 1_500_000, 3, True)]},
+            "psum": {"mode": "psum", "mesh": 4,
+                     "per_iter_bytes": 1_500_006,
+                     "per_iter_reduce_bytes": 6,
+                     "comms_fraction": 0.33,
+                     "collectives": [
+                         _crow("psum", "_mesh_sum", 4, 6, 6, True),
+                         _crow("all_gather", "_gradient",
+                               500_000, 1_500_000, 3, True)]},
+            "reduce_bytes_collapse": 250_000.0}
+    csum = comms_summary(crec)
+    comms_ok = (len(csum["modes"]) == 2
+                and csum["collapse"] == 250_000.0
+                and csum["modes"][0]["per_iter_reduce_bytes"] == 1_500_000
+                and csum["modes"][1]["per_iter_reduce_bytes"] == 6
+                and "UNBLESSED" not in render_comms(csum))
     ok = (summary["spans"].get("optimize.segment", {}).get("count") == 2
           and "prepare.knn" in summary["spans"]
           and summary["instants"].get("supervisor.oom") == 1
-          and mem_ok and pol_ok)
+          and mem_ok and pol_ok and comms_ok)
     if out_json:
         print(json.dumps({"ok": ok, "summary": {
             "spans": summary["spans"], "instants": summary["instants"],
             "segments": summary["segments"]}, "memory": msum,
-            "policy": psum}))
+            "policy": psum, "comms": csum}))
     else:
         print(render(summary))
         print()
         print(render_memory(msum))
         print()
         print(render_policy(psum))
+        print()
+        print(render_comms(csum))
         print(f"\nsmoke: {'ok' if ok else 'FAILED'}")
     return 0 if ok else 1
 
@@ -358,6 +466,10 @@ def main(argv=None) -> int:
                          "record JSON: stride/grid transitions (iter, "
                          "trigger, old->new, grad-norm at decision), "
                          "refresh count and effective s/iter")
+    ap.add_argument("--comms", metavar="RECORD_OR_PLAN",
+                    help="render the graftcomms per-collective inventory "
+                         "from a comms fixture / bench record (JAX-free) "
+                         "or a PlanConfig JSON (runs the live ring model)")
     args = ap.parse_args(argv)
     if args.smoke:
         return _smoke(args.json)
@@ -375,9 +487,16 @@ def main(argv=None) -> int:
         else:
             print(render_policy(psum))
         return 0
+    if args.comms:
+        csum = comms_summary(load_record(args.comms))
+        if args.json:
+            print(json.dumps(csum))
+        else:
+            print(render_comms(csum))
+        return 0
     if not args.trace:
         ap.error("a trace file is required (or --smoke / --memory / "
-                 "--policy)")
+                 "--policy / --comms)")
     summary = summarize(load_events(args.trace))
     if args.json:
         print(json.dumps(summary))

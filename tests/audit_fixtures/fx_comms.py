@@ -1,0 +1,19 @@
+"""comms-audit fixture: collective traffic the auditor must classify at
+exactly these lines — an unblessed full-N ``all_gather`` (the finding
+class: O(N) ICI bytes off the ``BLESSED_COMMS`` registry) and a scalar
+``psum`` handshake (unblessed too, so the repo-clean pin counts it, but
+below the N-scaling finding bar).  Imported and traced by
+tests/test_comms.py; like fx_determinism the provenance comes from
+``make_jaxpr`` source info, so the functions must be real."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def leaky_gather(x, axis_name):
+    full = lax.all_gather(x, axis_name, tiled=True)  # VIOLATION: unblessed full-N gather
+    return jnp.sum(full)
+
+
+def scalar_handshake(x, axis_name):
+    return lax.psum(jnp.sum(x), axis_name)  # scalar psum: unblessed, not N-scaling

@@ -36,15 +36,15 @@ def main(argv=None) -> int:
                         "(the README section is generated from this)")
     p.add_argument("--audit", action="store_true",
                    help="run graftcheck, the semantic audit tier: "
-                        "hbm-footprint, dtype-contract, compile-audit and "
-                        "sharding-contract over the repo's representative "
-                        "plans (imports JAX; CPU backend, abstract eval "
-                        "only)")
+                        "hbm-footprint, dtype-contract, compile-audit, "
+                        "sharding-contract, determinism-audit and "
+                        "comms-audit over the repo's representative plans "
+                        "(imports JAX; CPU backend, abstract eval only)")
     p.add_argument("--plan", action="append", default=None,
                    help="(--audit) audit these PlanConfig JSON file(s) "
                         "instead of the built-in representative plans")
     p.add_argument("--analyzers", default=None,
-                   help="(--audit) comma-separated subset of the five "
+                   help="(--audit) comma-separated subset of the six "
                         "analyzers to run")
     p.add_argument("--conc", action="store_true",
                    help="run graftrace, the static concurrency/protocol "

@@ -4,7 +4,7 @@ Where graftlint (:mod:`tsne_flink_tpu.analysis.rules`) proves SYNTACTIC
 contracts with ``ast`` alone, graftcheck proves SEMANTIC ones by tracing
 the real pipeline abstractly — ``jax.eval_shape`` / ``jax.make_jaxpr``
 over ShapeDtypeStructs, on the CPU backend, with no data and no device
-computation.  Five analyzers, one report format shared with graftlint:
+computation.  Six analyzers, one report format shared with graftlint:
 
 * ``hbm-footprint``     (:mod:`.hbm`)      — per-stage peak-HBM estimates
   for a :class:`~.plan.PlanConfig`, gated against the device budget; the
@@ -23,6 +23,11 @@ computation.  Five analyzers, one report format shared with graftlint:
   and 4) and transform jaxprs scanned for order-sensitive floating
   reductions off the blessed-site registry (``_mesh_sum``, spectral Z,
   float-exact counts): the mesh bit-identity contract, statically.
+* ``comms-audit``       (:mod:`.comms`)    — every collective in the
+  sharded programs priced under the v5e ICI ring model (payload bytes
+  from avals, per-iteration vs per-segment from a loop-aware jaxpr
+  walk), gated by the per-site ``BLESSED_COMMS`` registry; plans with a
+  mesh get a canonical-vs-psum reduction-traffic A/B (graftcomms).
 
 Entry points: ``python -m tsne_flink_tpu.analysis --audit`` (and
 ``scripts/lint.py --audit``) run the full repo audit; the CLI's
@@ -44,7 +49,7 @@ from tsne_flink_tpu.analysis.audit.plan import (  # noqa: F401
     HBM_BUDGET_BYTES, PlanConfig, bench_plan)
 
 ANALYZERS = ("hbm-footprint", "dtype-contract", "compile-audit",
-             "sharding-contract", "determinism-audit")
+             "sharding-contract", "determinism-audit", "comms-audit")
 
 
 def default_plans() -> list:
@@ -98,6 +103,11 @@ def run_audit(plans=None, analyzers=None) -> tuple[list, dict]:
         f, rep = det_audit.audit_determinism()
         findings.extend(f)
         report["determinism"] = rep
+    if "comms-audit" in selected:
+        from tsne_flink_tpu.analysis.audit import comms as comms_audit
+        f, rep = comms_audit.audit_comms(plans)
+        findings.extend(f)
+        report["comms"] = rep
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, report
 
@@ -123,6 +133,22 @@ def render_audit_human(findings, report) -> str:
             + ("(no budget)" if rep["hbm_budget"] is None else
                f"vs {round(rep['hbm_budget'] / (1 << 30), 2)} GiB budget "
                f"-> {'ok' if rep['ok'] else 'PREDICTED OOM'}"))
+    comms = report.get("comms")
+    if comms:
+        lines.append(
+            f"graftcheck: comms: {comms['unblessed']} unblessed "
+            f"collective(s) across {len(comms['programs'])} traced "
+            f"program(s)")
+        for name, pair in sorted(comms.get("plan_models", {}).items()):
+            if "skipped" in pair:
+                continue
+            c = pair["canonical"]
+            lines.append(
+                f"graftcheck: comms: plan {name}: mesh {c['mesh']}: "
+                f"{c['per_iter_bytes']} B/iter sent/device canonical, "
+                f"reduce slice {c['per_iter_reduce_bytes']} -> "
+                f"{pair['psum']['per_iter_reduce_bytes']} B under psum "
+                f"({round(pair['reduce_bytes_collapse'])}x collapse)")
     det = report.get("determinism")
     if det:
         unblessed = sum(p.get("unblessed", 0)

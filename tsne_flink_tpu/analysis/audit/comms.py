@@ -1,0 +1,579 @@
+"""comms-audit — the static collective-cost model (graftcomms, ISSUE 19).
+
+The communication twin of the HBM model (:mod:`.hbm`): trace the REAL
+sharded programs via ``jax.make_jaxpr`` at tiny parametric ``(N, mesh)``
+shapes, extract every collective primitive (``all_gather``, ``psum``,
+``ppermute``, ``all_to_all``, ``pmax``/``pmin``) with its payload bytes
+from the operand avals, its source-line provenance (the same
+trace-frames machinery as :mod:`.determinism`), and whether it fires
+INSIDE the optimize ``fori_loop`` (per-iteration) or outside it
+(per-segment), then compose a per-mesh ICI ring cost model into
+per-stage, per-iteration predicted comms bytes/seconds and a
+comms-vs-compute fraction for a :class:`~.plan.PlanConfig`.
+
+Why static extrapolation is sound here: a collective's payload is an
+aval — per-shard ``rows x width x itemsize``.  Widths are mesh- and
+N-invariant (``m`` components, ``2k`` neighbor columns, scalars), so a
+row classified as N-SCALING at the tiny trace (its per-shard payload
+carries >= rows-per-shard elements) extrapolates to plan scale by the
+rows-per-shard ratio alone; a non-scaling row (a scalar psum, a
+``[k]``-wide permute) costs the same at 1M rows as at 64.  That is the
+same trick the HBM model uses for transient attribution, applied to ICI
+traffic.
+
+The registry: ``BLESSED_COMMS`` mirrors determinism's ``BLESSED_SITES``
+— every collective must be issued by a function on the registry, with a
+rationale saying why its traffic is necessary (or why it is noise).  An
+UNBLESSED collective whose per-iteration bytes scale with full N is a
+finding; any unblessed collective at all fails the repo's comms-clean
+pin (tests/test_comms.py).  Blessed rows ride the ``--suppressions``
+ledger (analysis/core.collect_suppressions) so a new attestation is a
+reviewed event, exactly like a lint disable.
+
+The model's own 1M/v5e-8 fixture (tests/data/comms_1m_v5e8.json) is what
+motivates ``TSNE_MESH_REDUCE=psum`` (models/tsne._mesh_sum): the
+canonical mode pays an O(N) all_gather PER GLOBAL SCALAR per iteration;
+the psum mode collapses the reduction traffic by O(N/devices) while the
+canonical mode stays the verify oracle (KL guardrail, mesh bit-identity
+untouched).
+
+Abstract only: make_jaxpr over ShapeDtypeStructs on the CPU backend —
+no data, no device computation, mesh widths above the host's forced
+device count are recorded as skipped (determinism's contract).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tsne_flink_tpu.analysis.core import Finding
+
+RULE = "comms-audit"
+
+#: v5e ICI ring-link model.  Provenance: public Cloud TPU v5e docs list
+#: 1600 Gbps aggregate ICI per chip across 4 links -> 50 GB/s per link
+#: per direction, and published ring-collective microbenchmarks put the
+#: per-hop launch latency at ~1 us.  Like ops/knn.KNN_EXACT_EFF these
+#: are STATIC planning constants: decisions read RATIOS between plan
+#: variants (canonical vs psum, mesh 4 vs 8); absolute seconds are
+#: order-of-magnitude, and measured cross-host numbers go through bench
+#: records, never through these.
+ICI_LINK_BYTES_PER_S = 50e9
+ICI_HOP_LATENCY_S = 1e-6
+
+#: collective primitives the scan prices (jaxpr primitive names)
+COLLECTIVE_PRIMS = ("all_gather", "psum", "ppermute", "all_to_all",
+                    "pmax", "pmin")
+
+#: (function_name, file suffix) -> rationale.  A collective is blessed
+#: when the INNERMOST repo frame of its trace provenance names a row —
+#: unlike determinism's any-frame match, comms blessing is per issuing
+#: function, so blessing ``optimize`` wholesale is impossible and every
+#: site argues its own traffic.  Rows here ride the --suppressions
+#: ledger (core.collect_suppressions scans this literal), so adding one
+#: bumps the pinned suppression count: a reviewed event.
+BLESSED_COMMS = {
+    ("_mesh_sum", "models/tsne.py"):
+        "the canonical fixed-order global reduction: one [N] all_gather "
+        "per global scalar (or one scalar psum under "
+        "TSNE_MESH_REDUCE=psum) — the traffic this auditor's 1M fixture "
+        "quantifies and the psum mode collapses",
+    ("_gradient", "models/tsne.py"):
+        "the per-iteration [N, m] embedding gather every attraction/"
+        "repulsion form needs: forces couple all pairs, so each shard "
+        "must see the full y — the irreducible gradient traffic",
+    ("body", "models/tsne.py"):
+        "the fused/amortized loop body's own [N, m] embedding gather — "
+        "the twin of _gradient's, issued directly by the body closure "
+        "when the strided/autopilot refresh or the fused kernel owns "
+        "the repulsion pass (same bytes, different consumer)",
+    ("optimize", "models/tsne.py"):
+        "loop-invariant [N] validity-mask gather hoisted OUTSIDE the "
+        "fori_loop (XLA does not hoist collectives), plus the strided "
+        "refresh's embedding gather — per-segment, not per-iteration",
+    ("_global_mean", "models/tsne.py"):
+        "centering: numerator rides the [N, m] gather, denominator one "
+        "integer-valued scalar psum",
+    ("_psum", "models/tsne.py"):
+        "scalar psum wrapper: health AND-flag, valid-row counts — "
+        "4-8 bytes per call",
+    ("_pmax", "models/tsne.py"):
+        "scalar pmax wrapper: telemetry bbox/gains extrema",
+    ("_pmin", "models/tsne.py"):
+        "scalar pmin wrapper: telemetry bbox minima",
+    ("_telemetry_row", "models/tsne.py"):
+        "telemetry scalars at the KL report interval: norm partials ride "
+        "_mesh_sum, counts/extrema are scalar psum/pmax/pmin",
+    ("hop", "parallel/knn.py"):
+        "the bruteforce kNN ring (ring_knn): one [n/D, d] feature-block "
+        "ppermute per hop — point-to-point, the ICI-native pattern "
+        "(each shard forwards one block per step, no fan-in), total "
+        "bytes = one all_gather but bandwidth-overlapped with the fold",
+    ("project_knn_sharded", "parallel/knn.py"):
+        "projected kNN: gather the [N, d] features once per prepare "
+        "(every band needs arbitrary rows) and the final [N, k] "
+        "candidate graph for the refine funnel — per-segment, amortized "
+        "over the whole fit",
+    ("one_round", "parallel/knn.py"):
+        "per Z-order round: gather the band-sweep's sorted [N, k] "
+        "(dist, idx) results so every shard merges the same candidate "
+        "order — mesh-deterministic merge needs the global view",
+    ("_prepare_local", "parallel/pipeline.py"):
+        "replicated symmetrization: gather the [N, k] graph, compute "
+        "the deterministic sort everywhere, keep the local slice; the "
+        "pmax trio are scalar width/drop handshakes (vma typing)",
+    ("symmetrize_alltoall", "parallel/symmetrize.py"):
+        "P symmetrization: each (i, j) affinity must meet its (j, i) "
+        "twin once — one [n/D, W] all_to_all pair per prepare plus "
+        "scalar psum drop/width counters, the minimal shuffle the "
+        "reference pays as a Flink coGroup",
+}
+
+
+# ---- collective extraction (loop-aware jaxpr walk) -------------------------
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                yield getattr(item, "jaxpr", item)
+
+
+def _iter_eqns_looped(jaxpr, in_loop=False):
+    """Yield ``(eqn, in_loop)`` over ``jaxpr`` and every sub-jaxpr, where
+    ``in_loop`` is True once the walk has descended through a ``while``
+    or ``scan`` body — the static per-iteration/per-segment split
+    (dtype's ``_iter_jaxprs`` flattens exactly this context away, which
+    is why comms carries its own walker)."""
+    core_j = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in core_j.eqns:
+        yield eqn, in_loop
+        child_in_loop = in_loop or eqn.primitive.name in ("while", "scan")
+        for sub in _sub_jaxprs(eqn):
+            yield from _iter_eqns_looped(sub, child_in_loop)
+
+
+def _operand_bytes(eqn) -> tuple[int, int]:
+    """(payload_bytes, payload_elems) of one collective's per-shard
+    operands — the avals the issuing shard actually puts on the wire."""
+    nbytes = elems = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "dtype"):
+            continue
+        size = int(getattr(aval, "size", 0))
+        elems += size
+        nbytes += size * aval.dtype.itemsize
+    return nbytes, elems
+
+
+def _axis_of(eqn):
+    for p in ("axis_name", "axes"):
+        v = eqn.params.get(p)
+        if v is None:
+            continue
+        items = v if isinstance(v, (tuple, list)) else (v,)
+        names = [i for i in items if isinstance(i, str)]
+        if names:
+            return names[0]
+    return None
+
+
+def ring_cost(primitive: str, payload_bytes: int, devices: int):
+    """(sent_bytes_per_device, hops) under the ICI ring model for one
+    collective with per-shard payload ``payload_bytes`` over ``devices``
+    ring members.  Formulas are the standard ring lowerings: all_gather
+    forwards the shard D-1 times; psum (all-reduce) is reduce-scatter +
+    all-gather at 2(D-1)/D of the operand; all_to_all keeps 1/D at home;
+    ppermute is one point-to-point hop; pmax/pmin reduce like psum."""
+    d = max(1, int(devices))
+    if d == 1:
+        return 0, 0
+    b = float(payload_bytes)
+    if primitive == "all_gather":
+        return int(b * (d - 1)), d - 1
+    if primitive in ("psum", "pmax", "pmin"):
+        return int(2.0 * b * (d - 1) / d), 2 * (d - 1)
+    if primitive == "all_to_all":
+        return int(b * (d - 1) / d), d - 1
+    if primitive == "ppermute":
+        return int(b), 1
+    return int(b), 1
+
+
+def ring_seconds(sent_bytes: int, hops: int) -> float:
+    return hops * ICI_HOP_LATENCY_S + sent_bytes / ICI_LINK_BYTES_PER_S
+
+
+def _innermost_frame(eqn):
+    from tsne_flink_tpu.analysis.audit.determinism import _repo_frames
+    frames = _repo_frames(eqn)
+    return frames[0] if frames else None
+
+
+def _blessed_site(frame):
+    if frame is None:
+        return None
+    path, _line, func = frame
+    for (bfunc, bfile), _why in BLESSED_COMMS.items():
+        if func == bfunc and path.endswith(bfile):
+            return f"{bfunc} ({bfile})"
+    return None
+
+
+def collect_rows(jaxpr, label: str, devices: int, shard_rows: int) -> list:
+    """The per-collective inventory of one traced program: primitive,
+    axis, per-shard payload bytes, ring-model sent bytes/hops at
+    ``devices``, provenance, blessed site, N-scaling class and the
+    per-iteration flag.  ``shard_rows`` is rows-per-shard at the trace —
+    the N-scaling threshold (a payload of >= shard_rows elements grows
+    with the point count; widths never do)."""
+    rows = []
+    for eqn, in_loop in _iter_eqns_looped(jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS:
+            continue
+        payload, elems = _operand_bytes(eqn)
+        sent, hops = ring_cost(name, payload, devices)
+        frame = _innermost_frame(eqn)
+        path, line, func = frame if frame else (f"trace:{label}", 1, "?")
+        rows.append({
+            "primitive": name,
+            "axis": _axis_of(eqn),
+            "payload_bytes": payload,
+            "sent_bytes": sent,
+            "hops": hops,
+            "path": path, "line": line, "func": func,
+            "blessed": _blessed_site(frame),
+            "n_scaling": elems >= max(1, shard_rows),
+            "per_iteration": in_loop,
+        })
+    return rows
+
+
+def scan_rows(rows, label: str) -> list:
+    """Findings for one program's inventory: an UNBLESSED collective
+    whose per-iteration bytes scale with full N (the class that turns
+    into megabytes at 1M rows) is the finding; unblessed non-scaling
+    rows stay report-visible (the repo pin keeps them at zero too)."""
+    findings = []
+    for r in rows:
+        if r["blessed"] is not None or not r["n_scaling"]:
+            continue
+        when = "per-iteration" if r["per_iteration"] else "per-segment"
+        findings.append(Finding(
+            RULE, r["path"], r["line"], 0,
+            f"[{label}] unblessed {when} {r['primitive']} with N-scaling "
+            f"payload ({r['payload_bytes']} B/shard at the trace shape, "
+            f"-> {r['sent_bytes']} B sent/device on the ring) — O(N) ICI "
+            "traffic off the BLESSED_COMMS registry: route through "
+            "_mesh_sum, or attest the site with a rationale"))
+    return findings
+
+
+# ---- program builders (the real sharded programs, tiny shapes) -------------
+
+def _optimize_jaxpr(n_devices: int, *, n_components: int = 2,
+                    repulsion: str = "exact", with_health: bool = False,
+                    with_telemetry: bool = False, autopilot: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneConfig, TsneState, optimize
+    from tsne_flink_tpu.parallel.mesh import (AXIS, make_mesh, pspec,
+                                              rspec, state_pspec)
+    from tsne_flink_tpu.utils.compat import shard_map
+
+    mesh = make_mesh(n_devices)
+    n, k, m = 8 * n_devices, 4, n_components
+    cfg = TsneConfig(iterations=20, repulsion=repulsion, row_chunk=8,
+                     autopilot=autopilot)
+    state = TsneState(y=jax.ShapeDtypeStruct((n, m), jnp.float32),
+                      update=jax.ShapeDtypeStruct((n, m), jnp.float32),
+                      gains=jax.ShapeDtypeStruct((n, m), jnp.float32))
+    sspec = state_pspec()
+    out_specs = [sspec, rspec()]
+    if with_telemetry:
+        out_specs.append(rspec())
+    if autopilot:
+        # the pilot carry returns as ONE leaf-pair; a single replicated
+        # spec prefixes over the (pvec, trace) subtree
+        out_specs.append(rspec())
+    if with_health:
+        out_specs.append(rspec())
+    fn = shard_map(
+        lambda st, ji, jv: optimize(st, ji, jv, cfg, axis_name=AXIS,
+                                    with_health=with_health,
+                                    with_telemetry=with_telemetry),
+        mesh=mesh, in_specs=(sspec, pspec(), pspec()),
+        out_specs=tuple(out_specs))
+    return jax.make_jaxpr(fn)(
+        state, jax.ShapeDtypeStruct((n, 2 * k), jnp.int32),
+        jax.ShapeDtypeStruct((n, 2 * k), jnp.float32))
+
+
+def _prepare_jaxpr(knn_method: str, n_devices: int):
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.models.tsne import TsneConfig
+    from tsne_flink_tpu.parallel.mesh import make_mesh
+    from tsne_flink_tpu.parallel.pipeline import SpmdPipeline
+
+    make_mesh(n_devices)  # fail early with determinism's device message
+    n, d, k = 8 * n_devices, 8, 4
+    cfg = TsneConfig(iterations=4, perplexity=1.5, repulsion="exact",
+                     row_chunk=8)
+    pipe = SpmdPipeline(cfg, n, d, k, knn_method=knn_method, knn_rounds=1,
+                        knn_refine=1, n_devices=n_devices)
+    fn = pipe._build_prepared()
+    key_data = jnp.asarray(jax.random.key_data(jax.random.key(0)))
+    return jax.make_jaxpr(lambda *a: fn(*a))(
+        jax.ShapeDtypeStruct((n, d), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.bool_), key_data)
+
+
+def _alltoall_jaxpr(n_devices: int):
+    import jax
+    import jax.numpy as jnp
+
+    from tsne_flink_tpu.parallel.mesh import AXIS, make_mesh, pspec, rspec
+    from tsne_flink_tpu.parallel.symmetrize import symmetrize_alltoall
+    from tsne_flink_tpu.utils.compat import shard_map
+
+    mesh = make_mesh(n_devices)
+    n, k = 8 * n_devices, 4
+    fn = shard_map(
+        lambda i, p: symmetrize_alltoall(i, p, n_devices, 2 * k,
+                                         axis_name=AXIS),
+        mesh=mesh, in_specs=(pspec(), pspec()),
+        out_specs=(pspec(), pspec(), rspec(), rspec(), rspec()))
+    return jax.make_jaxpr(fn)(
+        jax.ShapeDtypeStruct((n, k), jnp.int32),
+        jax.ShapeDtypeStruct((n, k), jnp.float32))
+
+
+def _mode_env(mode: str):
+    """Context manager: pin $TSNE_MESH_REDUCE for the duration of a trace
+    (pick_mesh_reduce is a trace-time read) and restore the process env —
+    the same save/restore discipline as the CLI's --meshReduce."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        from tsne_flink_tpu.utils.env import env_raw
+        prev = env_raw("TSNE_MESH_REDUCE", None)
+        os.environ["TSNE_MESH_REDUCE"] = mode
+        try:
+            yield
+        finally:
+            if prev is None:
+                del os.environ["TSNE_MESH_REDUCE"]
+            else:
+                os.environ["TSNE_MESH_REDUCE"] = prev
+    return _ctx()
+
+
+# ---- the per-plan cost model ----------------------------------------------
+
+def plan_comms_report(plan, mode: str = "canonical") -> dict:
+    """Predicted ICI traffic for ``plan``'s optimize loop at its mesh
+    width under ``mode`` ('canonical' | 'psum'), from ONE tiny trace at
+    the same mesh: N-scaling rows extrapolate by the rows-per-shard
+    ratio, everything else is shape-exact.  Returns per-iteration bytes/
+    seconds (total and the _mesh_sum-attributable reduction slice — the
+    quantity the psum mode collapses), and the comms-vs-compute fraction
+    against the plan's analytic per-iteration FLOPs."""
+    from tsne_flink_tpu.parallel.mesh import padded_rows_for
+    from tsne_flink_tpu.utils.flops import optimize_flops, peak_flops
+
+    d = max(1, int(plan.mesh))
+    rep = plan.resolved_repulsion()
+    with _mode_env(mode):
+        jaxpr = _optimize_jaxpr(d, n_components=plan.n_components,
+                                repulsion=rep)
+    trace_shard_rows = 8
+    plan_shard_rows = padded_rows_for(plan.n, d) // d
+    factor = plan_shard_rows / trace_shard_rows
+    rows = collect_rows(jaxpr, f"optimize[mesh{d}:{mode}]", d,
+                        trace_shard_rows)
+
+    def at_plan(r):
+        payload = (int(r["payload_bytes"] * factor) if r["n_scaling"]
+                   else r["payload_bytes"])
+        sent, hops = ring_cost(r["primitive"], payload, d)
+        return payload, sent, hops
+
+    per_iter_bytes = per_iter_s = 0.0
+    reduce_bytes = reduce_s = 0.0
+    per_segment_bytes = 0.0
+    out_rows = []
+    for r in rows:
+        payload, sent, hops = at_plan(r)
+        secs = ring_seconds(sent, hops)
+        out_rows.append({**r, "payload_bytes": payload,
+                         "sent_bytes": sent})
+        if r["per_iteration"]:
+            per_iter_bytes += sent
+            per_iter_s += secs
+            if r["func"] == "_mesh_sum":
+                reduce_bytes += sent
+                reduce_s += secs
+        else:
+            per_segment_bytes += sent
+    # compute denominator: the plan's own analytic per-iteration FLOPs
+    # over the mesh's peak (attraction pairs at the lossless 2k bound —
+    # the same proxy sym_width_est falls back to)
+    flops_1 = optimize_flops(plan.n, plan.sym_width_est(),
+                             plan.n_components, 1, rep, theta=plan.theta)
+    peak, basis = peak_flops(plan.backend, device_kind="v5",
+                             devices=d)
+    compute_s = (flops_1 / peak) if peak else None
+    frac = (per_iter_s / (per_iter_s + compute_s)
+            if compute_s is not None and (per_iter_s + compute_s) > 0
+            else None)
+    return {
+        "plan": plan.name, "mode": mode, "mesh": d,
+        "repulsion": rep,
+        "rows_per_shard": plan_shard_rows,
+        "collectives": out_rows,
+        "per_iter_bytes": int(per_iter_bytes),
+        "per_iter_seconds": per_iter_s,
+        "per_iter_reduce_bytes": int(reduce_bytes),
+        "per_iter_reduce_seconds": reduce_s,
+        "per_segment_bytes": int(per_segment_bytes),
+        "per_run_bytes": int(per_iter_bytes * plan.iterations
+                             + per_segment_bytes),
+        "compute_seconds_per_iter": compute_s,
+        "comms_fraction": frac,
+        "peak_basis": basis,
+        "constants": {"ici_link_bytes_per_s": ICI_LINK_BYTES_PER_S,
+                      "ici_hop_latency_s": ICI_HOP_LATENCY_S},
+    }
+
+
+def plan_mode_pair(plan) -> dict:
+    """The canonical/psum A/B the committed 1M/v5e-8 fixture pins: both
+    modes' cost models plus the reduction-byte collapse ratio (the O(N)
+    -> O(1) claim, statically proven on the same traced program)."""
+    canonical = plan_comms_report(plan, "canonical")
+    psum = plan_comms_report(plan, "psum")
+    ratio = (canonical["per_iter_reduce_bytes"]
+             / max(1, psum["per_iter_reduce_bytes"]))
+    return {"canonical": canonical, "psum": psum,
+            "reduce_bytes_collapse": ratio}
+
+
+# ---- the repo audit --------------------------------------------------------
+
+def audit_comms(plans=None) -> tuple[list, dict]:
+    """Trace the repo's sharded programs (optimize mesh 1/4/8 with
+    health/telemetry/autopilot variants in BOTH reduce modes, sharded
+    prepare for both kNN methods, symmetrize_alltoall, the transform
+    stages for both repulsion backends), inventory every collective, and
+    flag unblessed N-scaling traffic; then run the per-plan cost model
+    for every plan carrying a mesh width > 1."""
+    import jax
+
+    from tsne_flink_tpu.analysis.audit.determinism import _transform_jaxprs
+
+    findings: list = []
+    programs: dict = {}
+    n_dev = len(jax.devices())
+
+    def scan(label, thunk, devices, shard_rows=8):
+        try:
+            jaxpr = thunk()
+        except Exception as e:  # noqa: BLE001 — a trace error IS a finding
+            findings.append(Finding(
+                RULE, f"trace:{label}", 1, 0,
+                f"program '{label}' fails to trace: "
+                f"{type(e).__name__}: {e}"))
+            programs[label] = {"error": f"{type(e).__name__}: {e}"}
+            return
+        rows = collect_rows(jaxpr, label, devices, shard_rows)
+        got = scan_rows(rows, label)
+        findings.extend(got)
+        programs[label] = {
+            "collectives": len(rows),
+            "unblessed": sum(1 for r in rows if r["blessed"] is None),
+            "n_scaling": sum(1 for r in rows if r["n_scaling"]),
+            "per_iteration": sum(1 for r in rows if r["per_iteration"]),
+            "blessed_sites": sorted({r["blessed"] for r in rows
+                                     if r["blessed"]}),
+            "rows": rows,
+        }
+
+    for d in (1, 4, 8):
+        if d > n_dev:
+            programs[f"optimize[mesh{d}]"] = {
+                "skipped": f"needs {d} devices, have {n_dev} (tier-1 "
+                           "forces 8 via "
+                           "--xla_force_host_platform_device_count)"}
+            continue
+        for mode in ("canonical", "psum"):
+            with _mode_env(mode):
+                scan(f"optimize[mesh{d}:{mode}]",
+                     lambda d=d: _optimize_jaxpr(d), d)
+        if d == 4:
+            # the variant surface once, at the middle width: health,
+            # telemetry and autopilot each add their own collectives
+            with _mode_env("canonical"):
+                scan("optimize[mesh4+health]",
+                     lambda: _optimize_jaxpr(4, with_health=True), 4)
+                scan("optimize[mesh4+telemetry]",
+                     lambda: _optimize_jaxpr(4, with_telemetry=True), 4)
+                scan("optimize[mesh4+pilot]",
+                     lambda: _optimize_jaxpr(4, autopilot=True), 4)
+                scan("optimize[mesh4+fft]",
+                     lambda: _optimize_jaxpr(4, repulsion="fft"), 4)
+    mesh_w = min(4, n_dev)
+    for method in ("bruteforce", "project"):
+        scan(f"prepare[{method}:mesh{mesh_w}]",
+             lambda m=method: _prepare_jaxpr(m, mesh_w), mesh_w)
+    scan(f"symmetrize[alltoall:mesh{mesh_w}]",
+         lambda: _alltoall_jaxpr(mesh_w), mesh_w)
+    for repulsion in ("exact", "fft"):
+        try:
+            staged = _transform_jaxprs(repulsion)
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                RULE, f"trace:transform[{repulsion}]", 1, 0,
+                f"transform stages ({repulsion}) fail to build/trace: "
+                f"{type(e).__name__}: {e}"))
+            continue
+        for label, jaxpr in staged:
+            # serving is single-device: the inventory proves ZERO
+            # collectives, so batch-split identity costs no ICI at all
+            scan(f"comms:{label}", lambda j=jaxpr: j, 1)
+
+    plan_reports: dict = {}
+    for plan in (plans or []):
+        if int(plan.mesh) <= 1:
+            continue
+        if int(plan.mesh) > n_dev:
+            plan_reports[plan.name] = {
+                "skipped": f"mesh {plan.mesh} needs {plan.mesh} devices, "
+                           f"have {n_dev}"}
+            continue
+        try:
+            plan_reports[plan.name] = plan_mode_pair(plan)
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                RULE, f"plan:{plan.name}", 1, 0,
+                f"comms model fails for plan '{plan.name}': "
+                f"{type(e).__name__}: {e}"))
+
+    report = {
+        "programs": programs,
+        "plan_models": plan_reports,
+        "blessed_registry": {f"{fn} ({path})": why
+                             for (fn, path), why in BLESSED_COMMS.items()},
+        "constants": {"ici_link_bytes_per_s": ICI_LINK_BYTES_PER_S,
+                      "ici_hop_latency_s": ICI_HOP_LATENCY_S},
+        "devices": n_dev,
+        "unblessed": sum(p.get("unblessed", 0) for p in programs.values()),
+        "ok": not findings,
+    }
+    return findings, report

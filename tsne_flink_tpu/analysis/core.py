@@ -218,10 +218,48 @@ def load_project(paths, root: str | None = None) -> Project:
     return Project(modules)
 
 
+def _blessed_comms_rows(display: str, source: str) -> list[dict]:
+    """Ledger rows for the comms-audit attestation registry: each
+    ``BLESSED_COMMS`` entry (audit/comms.py) is a reviewed exception to
+    'no collectives' exactly like a disable comment, so it rides the same
+    ledger and the same pinned count.  Scanned with stdlib ``ast`` — core
+    must NOT import the audit subpackage (that path pulls JAX, and comms
+    imports core for Finding)."""
+    import ast
+
+    rows: list[dict] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return rows
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "BLESSED_COMMS" not in targets:
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for key, val in zip(node.value.keys, node.value.values):
+            try:
+                func, file_suffix = ast.literal_eval(key)
+                rationale = ast.literal_eval(val)
+            except (ValueError, SyntaxError):
+                continue
+            rows.append({
+                "path": display, "line": key.lineno,
+                "rules": ["comms-audit"],
+                "scope": f"site:{func} ({file_suffix})",
+                "rationale": str(rationale),
+            })
+    return rows
+
+
 def collect_suppressions(paths, root: str | None = None) -> list[dict]:
     """The suppression ledger: every ``graftlint: disable`` comment under
     ``paths`` with its rules, scope and rationale (the text after ``--``,
-    plus any continuation comment lines below a standalone disable).
+    plus any continuation comment lines below a standalone disable), plus
+    the comms-audit ``BLESSED_COMMS`` attestations (same review bar).
     ``python -m tsne_flink_tpu.analysis --suppressions`` renders this;
     tier-1 pins the count so a new suppression is a deliberate diff."""
     root = root or os.getcwd()
@@ -233,6 +271,8 @@ def collect_suppressions(paths, root: str | None = None) -> list[dict]:
         with open(path, encoding="utf-8") as f:
             source = f.read()
         lines = source.splitlines()
+        if path.replace(os.sep, "/").endswith("analysis/audit/comms.py"):
+            rows.extend(_blessed_comms_rows(display, source))
         try:
             tokens = list(tokenize.generate_tokens(
                 io.StringIO(source).readline))

@@ -46,7 +46,8 @@ class TSNE:
                  health_check: bool = False,
                  aot_cache: bool | None = None,
                  telemetry: bool = False,
-                 autopilot: bool = False):
+                 autopilot: bool = False,
+                 mesh_reduce: str = "canonical"):
         self.n_components = n_components
         self.perplexity = perplexity
         self.early_exaggeration = early_exaggeration
@@ -162,6 +163,17 @@ class TSNE:
         # path bit-identical.  The policy block lands in
         # ``metrics_["policy"]`` after fit.
         self.autopilot = autopilot
+        # graftcomms (the CLI's --meshReduce / $TSNE_MESH_REDUCE): the
+        # global-reduction route.  "canonical" (default) defers to the
+        # environment, same arm-only contract as autopilot; "psum" opts
+        # this fit into the low-ICI per-shard reduction — O(1/devices)
+        # collective payload instead of _mesh_sum's O(N) gather, KL
+        # within the 0.05 guardrail of the canonical oracle but NOT
+        # bit-identical across mesh widths (models/tsne._mesh_sum).
+        if mesh_reduce not in ("canonical", "psum"):
+            raise ValueError(f"mesh_reduce '{mesh_reduce}' not defined "
+                             "(canonical | psum)")
+        self.mesh_reduce = mesh_reduce
         self.embedding_ = None
         self._fit_x = None
         self._frozen = None
@@ -244,6 +256,26 @@ class TSNE:
         return ArtifactCache(self.cache_dir)
 
     def _fit(self, x) -> "TSNE":
+        import os
+
+        from tsne_flink_tpu.utils.env import env_raw
+        if self.mesh_reduce != "canonical":
+            # pick_mesh_reduce is a trace-time env read (so AOT keys and
+            # the policy block record the mode that actually traced):
+            # arm it for this fit, restore after — same leak discipline
+            # as the matmul-dtype and aot_cache overrides above
+            prev_mr = env_raw("TSNE_MESH_REDUCE", None)
+            os.environ["TSNE_MESH_REDUCE"] = self.mesh_reduce
+            try:
+                return self._fit_aot(x)
+            finally:
+                if prev_mr is None:
+                    del os.environ["TSNE_MESH_REDUCE"]
+                else:
+                    os.environ["TSNE_MESH_REDUCE"] = prev_mr
+        return self._fit_aot(x)
+
+    def _fit_aot(self, x) -> "TSNE":
         from tsne_flink_tpu.utils import aot
         if self.aot_cache is not None:
             prev = aot.enabled_override()

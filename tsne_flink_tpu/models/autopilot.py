@@ -302,10 +302,12 @@ def policy_report(cfg: TsneConfig, pilot, iterations_run: int | None = None,
     iters = int(iterations_run if iterations_run is not None
                 else cfg.iterations)
     stride = max(1, int(getattr(cfg, "repulsion_stride", 1)))
+    from tsne_flink_tpu.models.tsne import pick_mesh_reduce
     from tsne_flink_tpu.ops.attraction_pallas import pick_fused_step
     base = {
         "autopilot": bool(getattr(cfg, "autopilot", False)),
         "fused_step": pick_fused_step(),
+        "mesh_reduce": pick_mesh_reduce(),
         "stride_ladder": list(STRIDE_LADDER),
         "grid_ladder": list(grid_ladder(cfg, cfg.n_components)),
         "kl_guardrail_tol": KL_GUARDRAIL_TOL,

@@ -131,6 +131,17 @@ def _pmin(x, axis_name):
     return x if axis_name is None else lax.pmin(x, axis_name)
 
 
+def pick_mesh_reduce() -> str:
+    """Resolved global-reduction route (graftcomms): 'canonical' keeps
+    :func:`_mesh_sum`'s fixed-order gather+sum, 'psum' arms the low-ICI
+    per-shard route.  Read at TRACE time like ``pick_fused_step`` — the
+    resolved mode is part of the program identity (AOT keys, the bench
+    ``policy`` block), so an env flip recompiles instead of loading a
+    stale executable."""
+    from tsne_flink_tpu.utils.env import env_str
+    return env_str("TSNE_MESH_REDUCE")
+
+
 def _mesh_sum(per_row, axis_name):
     """Mesh-canonical global sum of a per-row partial (graftmesh): gather
     the ``[N_padded]`` row vector — identical content and shape on every
@@ -138,9 +149,19 @@ def _mesh_sum(per_row, axis_name):
     — and reduce it in ONE fixed order.  This is the reduction the
     bit-identity contract (mesh D == mesh 1, pinned by tests/test_mesh.py)
     rides on: a per-shard ``psum`` would regroup the row sums per mesh
-    width.  Collective cost: one ``[N]`` all_gather per call — noise next
-    to the per-iteration ``[N, m]`` embedding gather the gradient already
-    pays."""
+    width.
+
+    Collective cost (graftcomms, analysis/audit/comms.py): one ``[N]``
+    all_gather per call — O(N) ICI bytes PER GLOBAL SCALAR, which the
+    comms auditor's 1M/v5e-8 fixture shows dominating the reduction
+    traffic.  ``TSNE_MESH_REDUCE=psum`` opts into the fast route: reduce
+    the shard locally, combine the scalars with one ``psum`` —
+    O(1/devices) payload, NOT bit-identical across mesh widths (per-shard
+    partials regroup), so the canonical mode stays the verify oracle and
+    the A/B is KL-guarded within the 0.05 guardrail
+    (tests/data/mesh_reduce_ab.json)."""
+    if axis_name is not None and pick_mesh_reduce() == "psum":
+        return lax.psum(jnp.sum(per_row), axis_name)
     return jnp.sum(lax.all_gather(per_row, axis_name, tiled=True))
 
 

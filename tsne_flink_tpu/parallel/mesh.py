@@ -305,6 +305,7 @@ class ShardedOptimizer:
             return fn
         wrapped = self._aot_fns.get(key)
         if wrapped is None:
+            from tsne_flink_tpu.models.tsne import pick_mesh_reduce
             from tsne_flink_tpu.ops.attraction_pallas import \
                 pick_attraction_kernel
             wrapped = aot.wrap(fn, {**aot.plan_key_parts(self.aot_plan),
@@ -318,6 +319,9 @@ class ShardedOptimizer:
                                     # stale executable
                                     "attraction_kernel":
                                         pick_attraction_kernel(),
+                                    # graftcomms: the reduction route is
+                                    # traced into the program the same way
+                                    "mesh_reduce": pick_mesh_reduce(),
                                     "cfg": repr(self.cfg)},
                                "optimize-seg")
             self._aot_fns[key] = wrapped

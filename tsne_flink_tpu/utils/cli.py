@@ -280,6 +280,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "within the pinned tolerance of the exact run. "
                         "Env default: $TSNE_AUTOPILOT; off = "
                         "bit-identical program")
+    p.add_argument("--meshReduce", default="canonical",
+                   choices=("canonical", "psum"),
+                   help="graftcomms global-reduction route "
+                        "(models/tsne._mesh_sum): 'canonical' (default) "
+                        "keeps the fixed-order [N] gather+sum — "
+                        "bit-identical across mesh widths, the verify "
+                        "oracle; 'psum' opts into the low-ICI per-shard "
+                        "route the comms auditor motivates — O(1/devices) "
+                        "collective payload, KL within the 0.05 guardrail "
+                        "but not bit-identical across widths. Env "
+                        "default: $TSNE_MESH_REDUCE")
     p.add_argument("--profile", default=None,
                    help="jax.profiler trace directory")
     # multi-host bring-up (jax.distributed over DCN — the analog of the
@@ -423,6 +434,28 @@ def _determinism_summary() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _comms_summary(plan) -> dict:
+    """One-program comms cross-section for the launch gate (graftcomms):
+    price this launch's optimize collectives under the RESOLVED reduce
+    mode at the plan's mesh width, plus the predicted per-iteration ICI
+    bytes and comms-vs-compute fraction.  The full program sweep lives in
+    ``--audit``; like the determinism line this never raises."""
+    try:
+        from tsne_flink_tpu.analysis.audit import comms
+        from tsne_flink_tpu.models.tsne import pick_mesh_reduce
+        mode = pick_mesh_reduce()
+        rep = comms.plan_comms_report(plan, mode)
+        rows = rep["collectives"]
+        return {"mode": mode, "mesh": rep["mesh"],
+                "unblessed": sum(1 for r in rows if r["blessed"] is None),
+                "collectives": len(rows),
+                "per_iter_bytes": rep["per_iter_bytes"],
+                "per_iter_reduce_bytes": rep["per_iter_reduce_bytes"],
+                "comms_fraction": rep["comms_fraction"]}
+    except Exception as e:  # noqa: BLE001 — advisory line, never fatal
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _audit_gate(args, cfg, n: int, assembly: str, neighbors: int):
     """--auditPlan: print the static plan audit and refuse a predicted OOM
     (the 'linter told us at second 4' gate; --auditPlan=warn overrides).
@@ -450,6 +483,19 @@ def _audit_gate(args, cfg, n: int, assembly: str, neighbors: int):
               + (", ".join(det["blessed_sites"]) or "none"))
         for line in det["findings"]:
             print(f"# auditPlan:   {line}")
+    com = _comms_summary(plan)
+    summary["comms"] = com
+    if "error" in com:
+        print(f"# auditPlan: comms: audit unavailable ({com['error']})")
+    else:
+        frac = com["comms_fraction"]
+        print(f"# auditPlan: comms: mode {com['mode']}: "
+              f"{com['per_iter_bytes']} B/iter sent/device over mesh "
+              f"{com['mesh']} (reduce slice "
+              f"{com['per_iter_reduce_bytes']} B); "
+              f"{com['unblessed']} unblessed collective(s)"
+              + ("" if frac is None
+                 else f"; ~{round(100 * frac)}% of step time"))
     if not rep["ok"]:
         msg = (f"plan predicted to OOM: peak HBM estimate "
                f"{rep['peak_hbm_est_gib']} GiB in the '{rep['peak_stage']}' "
@@ -623,6 +669,11 @@ def main(argv=None) -> int:
     prev = matmul_dtype()
     prev_aot = aot.enabled_override()
     prev_trace = obtrace.enabled_override()
+    # graftcomms: --meshReduce arms the env twin for the run (trace-time
+    # read, models/tsne.pick_mesh_reduce); restored here so an in-process
+    # caller cannot inherit a psum-mode program by accident
+    from tsne_flink_tpu.utils.env import env_raw
+    prev_mr = env_raw("TSNE_MESH_REDUCE", None)
     # the whole-run span is created HERE so the finally can close it on
     # every exit path (arg errors, --executionPlan early returns,
     # failures): a leaked open span would corrupt the parent stack of
@@ -639,6 +690,13 @@ def main(argv=None) -> int:
         set_matmul_dtype(prev)
         aot.set_enabled(prev_aot)
         obtrace.set_enabled(prev_trace)
+        if prev_mr is None:
+            # only _main sets the twin (and only for --meshReduce psum),
+            # so the unset->unset path must tolerate absence
+            if "TSNE_MESH_REDUCE" in os.environ:
+                del os.environ["TSNE_MESH_REDUCE"]
+        else:
+            os.environ["TSNE_MESH_REDUCE"] = prev_mr
 
 
 def _main(argv=None, sp_run=None) -> int:
@@ -657,6 +715,12 @@ def _main(argv=None, sp_run=None) -> int:
     from tsne_flink_tpu.utils import aot
     aot.set_enabled(args.aotCache)
     aot.install_compile_meter()
+
+    # graftcomms: an explicit --meshReduce arms the route for the whole
+    # run via its env twin (the default defers to $TSNE_MESH_REDUCE);
+    # main()'s finally restores the process state
+    if args.meshReduce != "canonical":
+        os.environ["TSNE_MESH_REDUCE"] = args.meshReduce
 
     # obs tracing (tsne_flink_tpu/obs/): --trace[=path] overrides the
     # $TSNE_TRACE default; the tracer is enabled up front so every stage

@@ -146,6 +146,17 @@ _declare("TSNE_FUSED_STEP", "str", "auto",
          "the optimize program byte-identical to the unfused (r12) "
          "trace. Recorded on the bench policy block as 'fused_step'.",
          choices=("auto", "on", "off"))
+_declare("TSNE_MESH_REDUCE", "str", "canonical",
+         "graftcomms global-reduction route (models/tsne.pick_mesh_reduce). "
+         "'canonical' (default) keeps _mesh_sum's fixed-order [N] "
+         "all_gather+sum — bit-identical across mesh widths, the verify "
+         "oracle. 'psum' is the opt-in fast mode the comms auditor "
+         "motivates: per-shard partial sums combined with one scalar psum "
+         "— O(1/devices) ICI payload instead of O(N), KL-guarded within "
+         "KL_GUARDRAIL_TOL of the canonical run but NOT bit-identical "
+         "across mesh widths. Recorded on the bench policy block as "
+         "'mesh_reduce' and on every AOT executable key.",
+         choices=("canonical", "psum"))
 _declare("TSNE_LANDMARK", "str", "auto",
          "graftfloor landmark coarse-to-fine schedule "
          "(models/autopilot.pick_landmark): optimize a seeded ~N/4 "
