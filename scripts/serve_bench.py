@@ -46,8 +46,8 @@ import numpy as np
 RECORD_BASE_KEYS = (
     "metric", "unit", "backend", "devices", "n", "d", "data", "data_seed",
     "fit_iters", "repulsion", "model_id", "aot_cache", "bucket", "iters",
-    "eta", "sched", "admission", "serve", "serve_mixed", "quality",
-    "smoke",
+    "eta", "sched", "admission", "serve", "serve_mixed", "serve_fleet",
+    "quality", "smoke",
 )
 
 #: below this many requests a p99 claim is numerology, not measurement —
@@ -172,6 +172,16 @@ def main(argv=None) -> int:
     p.add_argument("--mix-seed", type=int, default=None,
                    help="arrival-order shuffle seed (default "
                    "DATA_SEED + 7)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="run the graftquorum fleet phase with this many "
+                   "serve replicas against one shared spool (0 skips): "
+                   "availability under injected kill + a shed burst, "
+                   "emitted as the serve_fleet block")
+    p.add_argument("--fleet-shed-depth", type=int, default=4,
+                   help="TSNE_SERVE_SHED_DEPTH of the shed-burst phase")
+    p.add_argument("--fleet-run-s", type=float, default=900.0,
+                   help="supervisor deadline per fleet phase (stragglers "
+                   "are SIGKILLed and the record says so)")
     p.add_argument("--out", default=None, help="also write the final "
                    "record to this JSON path (atomic)")
     p.add_argument("--smoke", action="store_true",
@@ -225,7 +235,7 @@ def main(argv=None) -> int:
         "model_id": model.model_id, "aot_cache": aot.cache_label(),
         "bucket": bucket, "iters": iters, "eta": eta,
         "sched": None, "admission": None, "serve": None,
-        "serve_mixed": None, "quality": None,
+        "serve_mixed": None, "serve_fleet": None, "quality": None,
         "smoke": bool(a.smoke),
     }
 
@@ -374,6 +384,192 @@ def main(argv=None) -> int:
             # both mixed drains ride the SAME warm executables
             "compile_seconds": round(cm1["seconds"] - cm0["seconds"], 3),
         }
+
+    # ---- graftquorum: the replicated fleet under chaos -------------------
+    def fleet_block() -> dict:
+        """Two fleet phases over shared spools (serve/replicas.py):
+
+        * **kill** — N replica daemons drain a streamed request load
+          while the first two are SIGKILLed mid-request by their own
+          ``kill@serve:segK`` plans; the supervisor breaks the dead
+          claims, relaunches, and EVERY request must land bit-identical
+          to the in-process oracle (availability 1.0, lost pinned 0);
+        * **shed burst** — a pre-spooled backlog past
+          ``--fleet-shed-depth`` brownouts: bulk requests get fast
+          ``retry_after_ms`` refusals, express requests are all served.
+        """
+        import jax.numpy as jnp
+
+        from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+        from tsne_flink_tpu.models.tsne import TsneState
+        from tsne_flink_tpu.runtime.fleet import (ServeFleetSpec,
+                                                  run_serve_fleet)
+        from tsne_flink_tpu.serve.model import load_frozen
+        from tsne_flink_tpu.utils import checkpoint as ckpt
+
+        n_rep = int(a.replicas)
+        workdir = tempfile.mkdtemp(prefix="tsne_serve_fleet_")
+        model_path = os.path.join(workdir, "model.npz")
+        input_path = os.path.join(workdir, "x.npy")
+        st = TsneState(y=jnp.asarray(model.y),
+                       update=jnp.zeros_like(jnp.asarray(model.y)),
+                       gains=jnp.ones_like(jnp.asarray(model.y)))
+        ckpt.save(model_path, st, int(a.fit_iters), np.asarray([0.0]))
+        np.save(input_path, x)
+        # the oracle every replica must match bit-for-bit: the SAME fat
+        # checkpoint + input files, loaded in-process with the SAME
+        # serving parameters the replica specs carry
+        plan = PlanConfig(n=int(a.n), d=int(x.shape[1]), k=90,
+                          backend=jax.default_backend(),
+                          repulsion=model.repulsion, name="serve-fleet")
+        oracle = load_frozen(model_path, x, plan, perplexity=30.0,
+                             learning_rate=1000.0)
+        serve_tpl = {"model": model_path, "input": input_path,
+                     "perplexity": 30.0, "learning_rate": 1000.0,
+                     "neighbors": 90, "repulsion": model.repulsion,
+                     "bucket": bucket, "iters": iters, "eta": eta,
+                     "sched": a.sched}
+        stale_ms = 60_000.0
+        # stream pacing from the headline drain: roughly one request per
+        # per-request service time per replica, so claims spread across
+        # the fleet instead of one warm replica swallowing the backlog
+        per_req_s = drain_seconds / max(len(lats), 1)
+        gap_s = max(0.002, per_req_s / n_rep)
+        idle_s = max(1.0, 50.0 * gap_s)
+        child_env = {"TSNE_SERVE_TICK_S": "0.005",
+                     "TSNE_SERVE_IDLE_EXIT_S": str(round(idle_s, 3)),
+                     "TSNE_AOT_CACHE": "1", "TSNE_ARTIFACTS": "1"}
+
+        # -- phase 1: availability under kill ------------------------------
+        rows_a = max(1, a.request_rows // 4)
+        chunks, rids_a = {}, []
+        for i in range(0, a.queries, rows_a):
+            rid = f"f{i:06d}"
+            chunks[rid] = queries[i:i + rows_a]
+            rids_a.append(rid)
+        spool_a = os.path.join(workdir, "spool_kill")
+        os.makedirs(spool_a)
+        burst = min(len(rids_a), 2 * n_rep + 2)
+
+        def feed():
+            for j, rid in enumerate(rids_a):
+                submit(spool_a, chunks[rid], rid)
+                if j >= burst:
+                    time.sleep(gap_s)
+
+        fault_plans = {str(i): f"kill@serve:seg{i + 1}"
+                       for i in range(min(n_rep, 2))}
+        spec_a = ServeFleetSpec(
+            name="bench", spool=spool_a,
+            workdir=os.path.join(workdir, "work_kill"),
+            serve=serve_tpl, replicas=n_rep, stale_ms=stale_ms,
+            run_s=float(a.fleet_run_s), poll_s=0.05,
+            backoff_base=0.1, backoff_cap=1.0, fault_plans=fault_plans,
+            env=child_env,
+            record=os.path.join(workdir, "fleet_kill.json"))
+        feeder = threading.Thread(target=feed, daemon=True)
+        with obtrace.span("serve_bench.fleet_kill", cat="serve",
+                          replicas=n_rep) as sp_k:
+            feeder.start()
+            rec_kill = run_serve_fleet(spec_a)
+            feeder.join(timeout=60.0)
+        lost_a = [r for r in rids_a if read_result(spool_a, r) is None]
+        bit_identical = not lost_a
+        for rid in rids_a:
+            got = read_result(spool_a, rid)
+            if got is None:
+                continue
+            want = transform(oracle, chunks[rid], bucket=bucket,
+                             iters=iters, eta=eta)
+            if not np.array_equal(got, want):
+                bit_identical = False
+        lats_a = _read_lats(spool_a,
+                            [r for r in rids_a if r not in lost_a])
+        counts: dict = {}
+        for r in lats_a:
+            counts[r["replica"]] = counts.get(r["replica"], 0) + 1
+        kill_block = {
+            "fault_plans": fault_plans, "requests": len(rids_a),
+            "request_rows": rows_a, "served": len(rids_a) - len(lost_a),
+            "relaunches": rec_kill["relaunches"],
+            "sigkills": rec_kill["sigkills"],
+            "attempts": rec_kill["attempts"],
+            "redispatched": len(rec_kill["redispatched"]),
+            "deadline_hit": rec_kill["deadline_hit"],
+            "qps": round(a.queries / max(sp_k.seconds, 1e-9), 2),
+            "drain_seconds": round(sp_k.seconds, 3),
+            "p50_ms": _p50_ms([r["seconds"] for r in lats_a]),
+            "p99_ms": _p99_ms([r["seconds"] for r in lats_a]),
+        }
+
+        # -- phase 2: the shed burst ---------------------------------------
+        spool_b = os.path.join(workdir, "spool_shed")
+        os.makedirs(spool_b)
+        rng_b = np.random.default_rng(DATA_SEED + 9)
+        n_exp = n_bulk = 6
+        exp_ids = [f"e{i:02d}" for i in range(n_exp)]
+        bulk_ids = [f"b{i:02d}" for i in range(n_bulk)]
+        pool_b = (x[rng_b.integers(
+            0, a.n, n_exp * bucket + n_bulk * 2 * bucket)]).astype(x.dtype)
+        off = 0
+        for rid in exp_ids:      # express: one bucket -> never shed
+            submit(spool_b, pool_b[off:off + bucket], rid)
+            off += bucket
+        for rid in bulk_ids:     # bulk: two buckets -> shed candidates
+            submit(spool_b, pool_b[off:off + 2 * bucket], rid)
+            off += 2 * bucket
+        spec_b = ServeFleetSpec(
+            name="bench-shed", spool=spool_b,
+            workdir=os.path.join(workdir, "work_shed"),
+            serve=serve_tpl, replicas=min(2, n_rep), stale_ms=stale_ms,
+            shed_depth=int(a.fleet_shed_depth),
+            run_s=float(a.fleet_run_s), poll_s=0.05,
+            backoff_base=0.1, backoff_cap=1.0, env=child_env,
+            record=os.path.join(workdir, "fleet_shed.json"))
+        with obtrace.span("serve_bench.fleet_shed", cat="serve"):
+            run_serve_fleet(spec_b)
+        shed_n, retry_max, served_b, lost_b = 0, 0.0, 0, 0
+        exp_served = bulk_served = 0
+        for rid in exp_ids + bulk_ids:
+            if read_result(spool_b, rid) is not None:
+                served_b += 1
+                exp_served += rid in exp_ids
+                bulk_served += rid in bulk_ids
+                continue
+            err_path = os.path.join(spool_b, rid + ".err.json")
+            if not os.path.exists(err_path):
+                lost_b += 1
+                continue
+            with open(err_path, encoding="utf-8") as f:
+                err = json.load(f)
+            if err.get("shed"):
+                shed_n += 1
+                retry_max = max(retry_max, float(err["retry_after_ms"]))
+        shed_block = {
+            "shed_depth": int(a.fleet_shed_depth),
+            "express": {"n": n_exp, "served": exp_served},
+            "bulk": {"n": n_bulk, "served": bulk_served, "shed": shed_n},
+            "retry_after_ms_max": round(retry_max, 3),
+        }
+
+        served = kill_block["served"] + served_b
+        lost = len(lost_a) + lost_b
+        return {
+            "replicas": n_rep, "stale_ms": stale_ms,
+            "shed_depth": int(a.fleet_shed_depth),
+            "requests_total": len(rids_a) + n_exp + n_bulk,
+            "served": served, "shed": shed_n, "lost": lost,
+            "redispatched": len(rec_kill["redispatched"]),
+            "availability": round(served / max(served + lost, 1), 6),
+            "bit_identical": bool(bit_identical),
+            "per_replica_qps": {
+                k: round(v / max(sp_k.seconds, 1e-9), 3)
+                for k, v in sorted(counts.items())},
+            "kill": kill_block, "shed_burst": shed_block,
+        }
+
+    if a.replicas:
+        base["serve_fleet"] = fleet_block()
 
     # ---- quality pin: self-transform of a base-row sample ----------------
     sample = rng.choice(a.n, size=min(a.sample, a.n), replace=False)

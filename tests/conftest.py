@@ -25,7 +25,7 @@ _FAST_MODULES = {
     "test_checkpoint", "test_cli", "test_quality_gate", "test_cache",
     "test_artifacts", "test_knn_tiles", "test_audit", "test_runtime",
     "test_knn_kernel", "test_aot", "test_obs", "test_fleet", "test_mesh",
-    "test_attraction", "test_serve", "test_sched",
+    "test_attraction", "test_serve", "test_sched", "test_replicas",
 }
 
 

@@ -487,6 +487,56 @@ def test_committed_mixed_serve_record_holds_scheduler_ab_pins():
     assert on["batches"] > 0 and on["batch_fill_mean"] > 0
 
 
+REPLICAS_RECORD = "serve_60k_cpu_replicas_r20.json"
+
+
+def test_committed_fleet_record_holds_availability_and_shed_pins():
+    """graftquorum acceptance: the committed 60k 3-replica fleet record.
+
+    One shared spool, three serve daemons, the first two SIGKILLed
+    mid-request by their own ``kill@serve:segK`` plans:
+
+    * AVAILABILITY 1.0 — every submitted request reached a terminal
+      (lost is pinned 0): the supervisor detected the dead holders,
+      broke their claims, and the survivors (or relaunches) drained
+      the backlog;
+    * EXACTLY-ONCE lands bit-identically: at least one request was
+      re-dispatched under a bumped claim epoch, and every result file
+      equals the in-process oracle's transform byte-for-byte — no
+      zombie half-write survived the rename guard;
+    * SHEDDING is bulk-only: under the pre-spooled burst past
+      ``shed_depth``, every express (single-bucket) request was served
+      while shed refusals (with a positive ``retry_after_ms`` hint)
+      hit only the bulk lane."""
+    with open(os.path.join(REPO, "results", REPLICAS_RECORD)) as f:
+        rec = json.load(f)
+    assert rec["metric"] == "serve_qps" and rec["smoke"] is False
+    assert rec["n"] == 60_000
+    fleet = rec["serve_fleet"]
+    assert fleet["replicas"] == 3
+    assert fleet["availability"] == 1.0
+    assert fleet["lost"] == 0
+    assert fleet["served"] > 0
+    assert fleet["bit_identical"] is True
+    assert fleet["redispatched"] >= 1
+    # the chaos really fired: both seeded kills cost an attempt, and the
+    # supervisor relaunched into a clean spec (attempts >= 2)
+    kill = fleet["kill"]
+    assert kill["served"] == kill["requests"]
+    assert kill["relaunches"] >= 1
+    assert any(v >= 2 for v in kill["attempts"].values())
+    assert kill["deadline_hit"] is False
+    # shed policy: express immune, bulk refused with a retry hint
+    shed = fleet["shed_burst"]
+    assert shed["express"]["served"] == shed["express"]["n"]
+    assert shed["bulk"]["shed"] >= 1
+    assert fleet["shed"] == shed["bulk"]["shed"]
+    assert shed["retry_after_ms_max"] > 0
+    # work actually spread across the fleet, not one warm survivor
+    assert len(fleet["per_replica_qps"]) >= 2
+    assert all(v > 0 for v in fleet["per_replica_qps"].values())
+
+
 def test_landmark_bench_records_schedule_and_step_split():
     """graftfloor bench contract: TSNE_LANDMARK=on runs the coarse-to-fine
     schedule and the final record says so — the landmark decision and

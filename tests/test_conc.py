@@ -58,7 +58,8 @@ def test_repo_is_conc_clean():
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
     assert report["ok"] is True
     # the three tiers actually looked at the real thing, not an empty set
-    assert len(report["protocols"]) == 8
+    # (8 pre-quorum rows + heartbeat / claim-epoch / shed-refusal)
+    assert len(report["protocols"]) == 11
     assert report["locks"]["lock_sites"] > 0
     assert report["locks"]["order_cycles"] == []
     daemons = {t["module"] for t in report["tick"]}

@@ -1,0 +1,660 @@
+"""graftquorum (ISSUE 20): the replicated serve fleet.
+
+The chaos matrix, all CPU-only and tier-1:
+
+* **kill** — two replicas, each SIGKILLed (``kill@serve:seg0``) after
+  computing its first request but before the result write; the
+  supervisor breaks the dead claims, relaunches with backoff, and every
+  request reaches exactly ONE terminal, bit-identical to a direct
+  in-process transform;
+* **hang** — a replica wedges mid-drain (``hang@serve:2``) while its pid
+  stays alive; heartbeat staleness triages it as hung, the supervisor
+  SIGKILLs it, and its held claims re-dispatch (claim epoch bumped, the
+  zombie-write window closed by the rename guard);
+* **hot-swap under load** — a swap control file activates model B on one
+  replica while requests pinned to model A keep flowing; every response
+  is bit-identical to A (requests bind their model at claim);
+* **shed** — backlog past ``TSNE_SERVE_SHED_DEPTH`` refuses bulk-lane
+  requests with a ``retry_after_ms`` hint; express is never shed.
+
+Plus the protocol units underneath: the dead/hung/slow triage of
+``claim_stale_verdict`` (a slow-but-ALIVE holder's claim is never
+broken — the PR-14 age rule alone no longer decides), the claim-epoch
+rename guard (a zombie's late write aborts inside ``atomic_write``, tmp
+unlinked, the live claimant's bytes stand), and ``break_dead_claims``
+(only the dead holder's own locks break).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsne_flink_tpu.analysis.audit.plan import PlanConfig
+from tsne_flink_tpu.models.tsne import TsneState
+from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.runtime.admission import (ADMIT, SHED,
+                                              bounded_claim_rows,
+                                              decide_shed)
+from tsne_flink_tpu.runtime.fleet import (ServeFleetSpec, ServeSpec,
+                                          run_serve_fleet)
+from tsne_flink_tpu.serve import replicas as quorum
+from tsne_flink_tpu.serve.daemon import (ServeDaemon, StaleClaim,
+                                         _claim_current, read_result,
+                                         submit)
+from tsne_flink_tpu.serve.model import from_arrays, load_frozen
+from tsne_flink_tpu.serve.transform import transform
+from tsne_flink_tpu.utils import checkpoint as ckpt
+from tsne_flink_tpu.utils.io import atomic_write
+from tsne_flink_tpu.utils.locks import FileLock, read_lock_payload
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+# one frozen-model shape for the whole module (matches test_serve's
+# fixture so the serve path is already known-good at this size)
+N, D, M, K = 64, 5, 2, 8
+BUCKET, ITERS = 16, 6
+PERP, LR = 4.0, 100.0
+
+
+# ---- fixtures ---------------------------------------------------------------
+
+def _frozen_fixture(base_dir, seed=3, stem="model"):
+    """A fat v2 checkpoint + input features on disk (the files a replica
+    spec names), same construction as tests/test_serve.py."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((N, D)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((N, M))).astype(np.float32)
+    st = TsneState(y=jnp.asarray(y),
+                   update=jnp.zeros_like(jnp.asarray(y)),
+                   gains=jnp.ones_like(jnp.asarray(y)))
+    model_path = os.path.join(str(base_dir), stem + ".npz")
+    ckpt.save(model_path, st, 10, np.asarray([0.5]))
+    input_path = os.path.join(str(base_dir), stem + "_x.npy")
+    np.save(input_path, x)
+    return x, model_path, input_path
+
+
+def _oracle(model_path, x, name="quorum-oracle"):
+    plan = PlanConfig(n=N, d=D, k=K, backend="cpu", repulsion="exact",
+                      name=name)
+    return load_frozen(model_path, x, plan, perplexity=PERP,
+                       learning_rate=LR)
+
+
+def _serve_template(model_path, input_path):
+    """The ServeSpec template a fleet spec stamps replica fields onto."""
+    return {"model": model_path, "input": input_path,
+            "perplexity": PERP, "learning_rate": LR, "neighbors": K,
+            "repulsion": "exact", "bucket": BUCKET, "iters": ITERS}
+
+
+def _fleet_env(aot_dir, idle_s=0.75):
+    """Child-replica env: shared AOT cache (first compile persists, every
+    relaunch warm-loads) + fast ticks + idle-exit so a drained fleet
+    terminates instead of waiting out run_s."""
+    return {"JAX_PLATFORMS": "cpu", "TSNE_FORCE_CPU": "1",
+            "TSNE_ARTIFACTS": "0", "TSNE_AOT_CACHE": "1",
+            "TSNE_AOT_DIR": str(aot_dir), "TSNE_TRACE": "0",
+            "TSNE_SERVE_TICK_S": "0.01",
+            "TSNE_SERVE_IDLE_EXIT_S": str(idle_s)}
+
+
+def _queries(rows, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((rows, D)).astype(np.float32)
+
+
+def _terminal_listing(rids, extra=()):
+    names = list(extra)
+    for rid in rids:
+        names += [rid + ".lat.json", rid + ".res.npz"]
+    return sorted(names)
+
+
+@pytest.fixture(scope="module")
+def quorum_env(tmp_path_factory):
+    """Module-shared fixture files + a PRE-WARMED AOT cache: one clean
+    single-replica fleet run through the ``--serve-fleet`` CLI serves a
+    request cold (compiling + persisting the serve stage executables);
+    every later fleet test warm-loads, so heartbeat gaps stay small and
+    the hung-triage thresholds are honest."""
+    base = tmp_path_factory.mktemp("quorum")
+    x, model_path, input_path = _frozen_fixture(base)
+    aot = base / "aot"
+    os.makedirs(aot)
+    spool = str(base / "warm_spool")
+    workdir = str(base / "warm_work")
+    os.makedirs(spool)
+    q = _queries(9, seed=100)
+    submit(spool, q, "warm0")
+    record_path = str(base / "warm_fleet.json")
+    spec = ServeFleetSpec(
+        name="warmfleet", spool=spool, workdir=workdir,
+        serve=_serve_template(model_path, input_path), replicas=1,
+        stale_ms=60000.0, run_s=240.0, poll_s=0.05,
+        backoff_base=0.05, backoff_cap=0.2,
+        env=_fleet_env(aot), record=record_path)
+    spec_path = spec.save(str(base / "warm_fleet.spec.json"))
+    r = subprocess.run(
+        [sys.executable, "-m", "tsne_flink_tpu.runtime.fleet",
+         "--serve-fleet", spec_path],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(record_path) as f:
+        record = json.load(f)
+    return {"base": base, "aot": aot, "x": x, "model": model_path,
+            "input": input_path, "oracle": _oracle(model_path, x),
+            "warm_record": record, "warm_spool": spool,
+            "warm_query": q}
+
+
+# ---- knob resolvers ---------------------------------------------------------
+
+def test_knob_resolvers_explicit_env_and_bounds(monkeypatch):
+    assert quorum.pick_serve_replicas(3) == 3
+    monkeypatch.setenv("TSNE_SERVE_REPLICAS", "4")
+    assert quorum.pick_serve_replicas() == 4
+    with pytest.raises(ValueError, match="replica count"):
+        quorum.pick_serve_replicas(0)
+    assert quorum.pick_replica_stale_ms(250.0) == 250.0
+    with pytest.raises(ValueError, match="stale bound"):
+        quorum.pick_replica_stale_ms(0.0)
+    assert quorum.pick_shed_depth(0) == 0     # 0 = shedding off
+    assert quorum.pick_shed_depth(7) == 7
+    with pytest.raises(ValueError, match="shed depth"):
+        quorum.pick_shed_depth(-1)
+
+
+def test_serve_fleet_spec_roundtrip_filters_unknown(tmp_path):
+    spec = ServeFleetSpec(name="f", spool="/s", workdir="/w",
+                          replicas=2, fault_plans={"0": "kill@serve:seg0"})
+    path = spec.save(str(tmp_path / "fleet.json"))
+    loaded = ServeFleetSpec.load(path)
+    assert loaded.as_dict() == spec.as_dict()
+    aug = {**spec.as_dict(), "not_a_field": 1}
+    assert ServeFleetSpec.from_dict(aug).as_dict() == spec.as_dict()
+
+
+# ---- shed policy (runtime/admission) ---------------------------------------
+
+def test_decide_shed_bulk_only_and_retry_hint():
+    # backlog at/below depth: admit everything
+    assert decide_shed(4, 2048, 256, 4, 400.0).action == ADMIT
+    # over depth: express (fits one bucket) is NEVER shed before bulk
+    assert decide_shed(5, 256, 256, 4, 400.0).action == ADMIT
+    v = decide_shed(9, 2048, 256, 4, 400.0)
+    assert v.action == SHED
+    # hint scales with the excess backlog: deadline x (backlog - depth)
+    assert v.retry_after_ms == pytest.approx(400.0 * 5)
+    assert "backlog" in v.reason
+    # depth 0 disables shedding entirely
+    assert decide_shed(10_000, 4096, 256, 0, 400.0).action == ADMIT
+
+
+def test_bounded_claim_rows_budget_clamp():
+    # no budget: the default horizon stands
+    assert bounded_claim_rows(4096, 256, 10**9, None) == 4096
+    # budget bounds queue-depth x peak, floored at one bucket
+    assert bounded_claim_rows(4096, 256, 10**9, 3 * 10**9) == 768
+    assert bounded_claim_rows(4096, 256, 10**9, 1) == 256
+    # ample budget: clamped to the default, never above it
+    assert bounded_claim_rows(4096, 256, 1, 10**12) == 4096
+
+
+# ---- the hang fault kind (runtime/faults) ----------------------------------
+
+def test_hang_fault_parses_and_fires_at_site_entry():
+    (f,) = faults.parse_plan("hang@serve:2")
+    assert (f.kind, f.site, f.trigger, f.fired) == ("hang", "serve", "2",
+                                                    False)
+    assert faults.POINT_FOR_KIND["hang"] == "start"
+    with pytest.raises(ValueError, match="site 'job' takes kinds"):
+        faults.parse_plan("hang@job:1")   # no fleet-level hang clause
+
+
+def test_hang_payload_blocks_forever_pid_alive():
+    """``hang@knn:1`` wedges the process at the site entry: no exit, no
+    output, pid alive and signalable — the exact evidence shape the
+    hung-replica triage keys on (jax-free child, so this is cheap)."""
+    code = ("import sys\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from tsne_flink_tpu.runtime import faults\n"
+            "faults.activate('hang@knn:1')\n"
+            "faults.injector().fire('knn')\n"
+            "print('unreachable')\n")
+    p = subprocess.Popen([sys.executable, "-c", code], cwd=REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        with pytest.raises(subprocess.TimeoutExpired):
+            p.wait(timeout=3.0)
+        assert p.poll() is None and quorum.pid_alive(p.pid)
+    finally:
+        p.kill()
+        p.wait()
+
+
+# ---- heartbeats + the dead/hung/slow triage ---------------------------------
+
+def test_heartbeat_roundtrip_and_sweep(tmp_path):
+    spool = str(tmp_path)
+    assert quorum.read_beat(spool, "r0") is None
+    quorum.write_beat(spool, "r0", 3, ["b", "a"])
+    beat = quorum.read_beat(spool, "r0")
+    assert beat["replica"] == "r0" and beat["seq"] == 3
+    assert beat["pid"] == os.getpid()
+    assert beat["claimed"] == ["a", "b"]   # manifest sorted
+    quorum.clear_beats(spool)
+    assert os.listdir(spool) == []
+    assert quorum.read_beat(spool, "") is None
+
+
+def _write_claim(spool, rid, pid, replica=None):
+    lines = [f"pid={pid}\n"]
+    if replica is not None:
+        lines.append(f"replica={replica}\n")
+    path = os.path.join(spool, rid + quorum.CLAIM_LOCK_SUFFIX)
+    with open(path, "w") as f:
+        f.write("".join(lines))
+    return path
+
+
+def _dead_pid():
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+def test_claim_stale_verdict_dead_hung_slow(tmp_path):
+    spool = str(tmp_path)
+    # dead holder: break NOW regardless of age
+    dead = _write_claim(spool, "d0", _dead_pid(), "rX")
+    assert quorum.claim_stale_verdict(dead, 0.0, spool=spool,
+                                      replica_stale_s=60.0) is True
+    # alive holder with a FRESH beat (same pid): NEVER broken — this is
+    # the delay-holder regression the pure age rule used to get wrong
+    live = _write_claim(spool, "l0", os.getpid(), "rY")
+    quorum.write_beat(spool, "rY", 1, ["l0"])
+    assert quorum.claim_stale_verdict(live, 1e6, spool=spool,
+                                      replica_stale_s=60.0) is False
+    # same holder judged against a zero staleness budget: beat is not
+    # fresh enough to protect -> age rule decides (None)
+    assert quorum.claim_stale_verdict(live, 1e6, spool=spool,
+                                      replica_stale_s=0.0) is None
+    # alive holder, no beat at all -> age rule
+    bare = _write_claim(spool, "b0", os.getpid(), "rZ")
+    assert quorum.claim_stale_verdict(bare, 0.0, spool=spool,
+                                      replica_stale_s=60.0) is None
+    # anonymous (pre-quorum payload) -> age rule
+    anon = os.path.join(spool, "a0" + quorum.CLAIM_LOCK_SUFFIX)
+    with open(anon, "w") as f:
+        f.write("claim=serve\n")
+    assert quorum.claim_stale_verdict(anon, 0.0, spool=spool,
+                                      replica_stale_s=60.0) is None
+
+
+def test_stale_break_never_fires_on_live_beating_holder(tmp_path):
+    """A jax-free subprocess holds a claim lock far past the PLAIN age
+    bound while beating; a contender must NOT break it.  The moment the
+    holder dies, the verdict flips to dead and the break is immediate —
+    no TSNE_LOCK_STALE_S wait."""
+    spool = str(tmp_path)
+    lock_path = os.path.join(spool, "h0" + quorum.CLAIM_LOCK_SUFFIX)
+    code = ("import os, sys, time\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from tsne_flink_tpu.serve import replicas as quorum\n"
+            "from tsne_flink_tpu.utils.locks import FileLock\n"
+            f"lock = FileLock({lock_path!r}, stale_s=3600.0,\n"
+            "                payload={'replica': 'rH'})\n"
+            "assert lock.acquire(timeout_s=2.0)\n"
+            f"quorum.write_beat({spool!r}, 'rH', 1, ['h0'])\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(120)\n")
+    p = subprocess.Popen([sys.executable, "-c", code], cwd=REPO,
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "ready"
+
+        def stale(path, age):
+            return quorum.claim_stale_verdict(path, age, spool=spool,
+                                              replica_stale_s=60.0)
+        contender = FileLock(lock_path, stale_s=0.05, stale_fn=stale)
+        # age passes 0.05s many times over during this window; the live
+        # beat must hold the claim anyway
+        assert contender.acquire(timeout_s=0.6) is False
+        assert read_lock_payload(lock_path).get("replica") == "rH"
+        p.kill()
+        p.wait()
+        # dead holder: verdict True breaks on the first poll
+        assert contender.acquire(timeout_s=2.0) is True
+        contender.release()
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+
+
+def test_break_dead_claims_only_dead_same_replica(tmp_path):
+    spool = str(tmp_path)
+    _write_claim(spool, "a", _dead_pid(), "r0")       # dead r0: break
+    live = _write_claim(spool, "b", os.getpid(), "r0")  # relaunched r0
+    other = _write_claim(spool, "c", _dead_pid(), "r1")  # r1's corpse
+    anon = os.path.join(spool, "d" + quorum.CLAIM_LOCK_SUFFIX)
+    with open(anon, "w") as f:
+        f.write("claim=serve\n")
+    assert quorum.break_dead_claims(spool, "r0") == ["a"]
+    assert not os.path.exists(os.path.join(
+        spool, "a" + quorum.CLAIM_LOCK_SUFFIX))
+    assert os.path.exists(live) and os.path.exists(other)
+    assert os.path.exists(anon)
+
+
+# ---- claim epochs + the rename guard ---------------------------------------
+
+def test_epoch_sidecar_bump_read_clear(tmp_path):
+    spool = str(tmp_path)
+    assert quorum.read_epoch(spool, "r") == 0
+    lock = FileLock(os.path.join(spool, "r" + quorum.CLAIM_LOCK_SUFFIX),
+                    payload={"replica": "r0"})
+    assert lock.acquire(timeout_s=0.0)
+    try:
+        assert quorum.bump_epoch(spool, "r", lock) == 1
+        assert quorum.bump_epoch(spool, "r", lock) == 2
+        assert quorum.read_epoch(spool, "r") == 2
+    finally:
+        lock.release()
+    quorum.clear_epoch(spool, "r")
+    assert quorum.read_epoch(spool, "r") == 0
+    quorum.clear_epoch(spool, "r")   # idempotent
+
+
+def test_rename_guard_discards_zombie_write(tmp_path):
+    """The exactly-once core: claim at epoch 1, get stale-broken and
+    re-claimed at epoch 2 — the zombie's late write raises StaleClaim
+    inside the writer callback, atomic_write unlinks its tmp, and the
+    live claimant's bytes stand alone."""
+    spool = str(tmp_path)
+    lock_path = os.path.join(spool, "z0" + quorum.CLAIM_LOCK_SUFFIX)
+    res = os.path.join(spool, "z0.res.npz")
+
+    zombie = FileLock(lock_path, payload={"replica": "r0"})
+    assert zombie.acquire(timeout_s=0.0)
+    e1 = quorum.bump_epoch(spool, "z0", zombie)
+    zombie.write_payload({"epoch": e1})
+    assert _claim_current(zombie, e1)
+
+    os.remove(lock_path)   # the supervisor breaking the dead claim
+    live = FileLock(lock_path, payload={"replica": "r1"})
+    assert live.acquire(timeout_s=0.0)
+    e2 = quorum.bump_epoch(spool, "z0", live)
+    live.write_payload({"epoch": e2})
+    assert e2 == 2 and _claim_current(live, e2)
+    assert not _claim_current(zombie, e1)
+
+    # live claimant lands its result (guard passes: lock names e2)
+    def write_live(tmp):
+        with open(tmp, "wb") as f:
+            np.savez(f, y=np.full((3, M), 2.0, np.float32))
+        if not _claim_current(live, e2):
+            raise StaleClaim("z0")
+    atomic_write(res, write_live, tag=f"e{e2}")
+
+    # the zombie's LATE write: bytes reach the tmp, the guard aborts the
+    # rename, the tmp is unlinked — the live result is untouched
+    def write_zombie(tmp):
+        with open(tmp, "wb") as f:
+            np.savez(f, y=np.zeros((3, M), np.float32))
+        if not _claim_current(zombie, e1):
+            raise StaleClaim("z0")
+    with pytest.raises(StaleClaim):
+        atomic_write(res, write_zombie, tag=f"e{e1}")
+
+    with np.load(res) as z:
+        np.testing.assert_array_equal(
+            z["y"], np.full((3, M), 2.0, np.float32))
+    assert not [n for n in os.listdir(spool) if n.endswith(".tmp")]
+    live.release()
+
+
+# ---- overload shedding in the daemon ---------------------------------------
+
+def test_daemon_sheds_bulk_before_express(tmp_path):
+    """Backlog 5 > depth 1: every multi-bucket (bulk) request gets a fast
+    ``retry_after_ms`` refusal; every single-bucket (express) request is
+    served — express is never shed before bulk."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((96, 6)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((96, M))).astype(np.float32)
+    plan = PlanConfig(n=96, d=6, k=12, backend="cpu", repulsion="exact",
+                      name="shed-test")
+    model = from_arrays(x, y, plan, perplexity=PERP, learning_rate=LR)
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    express, bulk = {}, {}
+    for i in range(2):
+        express[f"e{i}"] = rng.standard_normal((8, 6)).astype(np.float32)
+    for i in range(3):
+        bulk[f"b{i}"] = rng.standard_normal((32, 6)).astype(np.float32)
+    for rid, q in {**express, **bulk}.items():
+        submit(spool, q, rid)
+    d = ServeDaemon(model, spool, bucket=BUCKET, iters=4, tick_s=0.001,
+                    shed_depth=1)
+    summary = d.serve_forever(max_ticks=10)
+    assert summary["shed_depth"] == 1
+    assert summary["served"] == 2 and summary["shed"] == 3
+    assert summary["failed"] == 0
+    for rid, q in express.items():
+        np.testing.assert_array_equal(
+            read_result(spool, rid),
+            transform(model, q, bucket=BUCKET, iters=4))
+    for rid in bulk:
+        with open(os.path.join(spool, rid + ".err.json")) as f:
+            err = json.load(f)
+        assert err["shed"] is True and err["req"] == rid
+        assert err["retry_after_ms"] > 0
+    assert sorted(os.listdir(spool)) == _terminal_listing(
+        express, extra=[rid + ".err.json" for rid in bulk])
+
+
+# ---- the fleet: clean baseline ---------------------------------------------
+
+def test_fleet_clean_baseline_cli_record(quorum_env):
+    """The ``--serve-fleet`` CLI happy path (the warm-up run): one
+    replica, one request, spool drained to terminals only, fleet record
+    coherent, result bit-identical to a direct transform."""
+    rec = quorum_env["warm_record"]
+    assert rec["replicas"] == ["warmfleet-r0"]
+    assert rec["deadline_hit"] is False
+    assert rec["sigkills"] == 0 and rec["redispatched"] == []
+    assert rec["attempts"] == {"warmfleet-r0": 1}
+    sub = rec["replica_records"]["warmfleet-r0"]
+    assert sub["status"] == "ok" and sub["served"] == 1
+    assert sub["replica"] == "warmfleet-r0"
+    spool = quorum_env["warm_spool"]
+    assert sorted(os.listdir(spool)) == _terminal_listing(["warm0"])
+    np.testing.assert_array_equal(
+        read_result(spool, "warm0"),
+        transform(quorum_env["oracle"], quorum_env["warm_query"],
+                  bucket=BUCKET, iters=ITERS))
+    with open(os.path.join(spool, "warm0.lat.json")) as f:
+        lat = json.load(f)
+    assert lat["replica"] == "warmfleet-r0" and lat["epoch"] == 1
+
+
+# ---- the fleet chaos matrix -------------------------------------------------
+
+def _run_fleet(quorum_env, tmp_path, tag, *, replicas, fault_plans,
+               stale_ms, rids, run_s=240.0, shed_depth=None,
+               idle_s=0.75, serve_extra=None):
+    spool = str(tmp_path / f"{tag}_spool")
+    workdir = str(tmp_path / f"{tag}_work")
+    os.makedirs(spool)
+    queries = {}
+    for i, (rid, rows) in enumerate(rids.items()):
+        queries[rid] = _queries(rows, seed=200 + i)
+        submit(spool, queries[rid], rid)
+    serve = _serve_template(quorum_env["model"], quorum_env["input"])
+    serve.update(serve_extra or {})
+    spec = ServeFleetSpec(
+        name=tag, spool=spool, workdir=workdir, serve=serve,
+        replicas=replicas, stale_ms=stale_ms, shed_depth=shed_depth,
+        run_s=run_s, poll_s=0.05, max_attempts=3,
+        backoff_base=0.05, backoff_cap=0.2, fault_plans=fault_plans,
+        env=_fleet_env(quorum_env["aot"], idle_s=idle_s),
+        record=str(tmp_path / f"{tag}_record.json"))
+    record = run_serve_fleet(spec)
+    return record, spool, queries
+
+
+def _assert_exactly_once_bitidentical(quorum_env, spool, queries,
+                                      extra=()):
+    """Every request: exactly one terminal, bytes identical to the
+    unfailed serial oracle; the drained spool holds terminals only."""
+    oracle = quorum_env["oracle"]
+    for rid, q in queries.items():
+        got = read_result(spool, rid)
+        assert got is not None, f"{rid} has no result"
+        np.testing.assert_array_equal(
+            got, transform(oracle, q, bucket=BUCKET, iters=ITERS))
+    assert sorted(os.listdir(spool)) == _terminal_listing(
+        queries, extra=extra)
+
+
+def test_fleet_kill_chaos_exactly_once_bitidentical(quorum_env, tmp_path):
+    """Both replicas die by their own ``kill@serve:seg0`` — SIGKILL after
+    computing a first request, BEFORE its result write — while holding
+    claims.  The supervisor breaks the dead claims (re-dispatch),
+    relaunches clean with backoff, and the drained spool is bit-identical
+    to a run where nothing ever failed."""
+    rids = {"q00": 7, "q01": 16, "q02": 9, "q03": 3, "q04": 12}
+    rec, spool, queries = _run_fleet(
+        quorum_env, tmp_path, "killfleet", replicas=2,
+        fault_plans={"0": "kill@serve:seg0", "1": "kill@serve:seg0"},
+        stale_ms=60000.0, rids=rids)
+    assert rec["deadline_hit"] is False
+    _assert_exactly_once_bitidentical(quorum_env, spool, queries)
+    # at least one replica claimed work, died at the boundary and came
+    # back: its held claims re-dispatched, its attempt counter advanced
+    assert len(rec["redispatched"]) >= 1
+    assert set(rec["redispatched"]) <= set(rids)
+    assert rec["relaunches"] >= 1
+    assert max(rec["attempts"].values()) >= 2
+    assert rec["sigkills"] == 0      # self-inflicted kills, not triage
+    exits = [e for e in rec["events"] if e["event"] == "exit"]
+    assert any(e["rc"] == -signal.SIGKILL for e in exits)
+    # a re-dispatched request carries the bumped claim epoch on its
+    # latency record — the exactly-once evidence, recorded
+    rid = rec["redispatched"][0]
+    with open(os.path.join(spool, rid + ".lat.json")) as f:
+        lat = json.load(f)
+    assert lat["epoch"] >= 2
+    assert lat["replica"] in rec["attempts"]
+    for name, sub in rec["replica_records"].items():
+        assert sub is not None and sub["status"] == "ok", name
+
+
+def test_fleet_hang_chaos_sigkill_redispatch(quorum_env, tmp_path):
+    """``hang@serve:2`` wedges the only replica mid-drain with claims
+    held and its pid alive — lock age alone would call that claim stale,
+    but the beat protects it until the beat itself goes stale.  The
+    supervisor's hung triage SIGKILLs, breaks the claims, relaunches,
+    and the backlog drains exactly-once."""
+    rids = {"h00": 8, "h01": 8, "h02": 8, "h03": 8}
+    rec, spool, queries = _run_fleet(
+        quorum_env, tmp_path, "hangfleet", replicas=1,
+        fault_plans={"0": "hang@serve:2"}, stale_ms=1500.0, rids=rids,
+        idle_s=1.0)
+    assert rec["deadline_hit"] is False
+    _assert_exactly_once_bitidentical(quorum_env, spool, queries)
+    assert rec["sigkills"] >= 1
+    assert any(e["event"] == "sigkill-hung" for e in rec["events"])
+    assert len(rec["redispatched"]) >= 1
+    assert rec["attempts"]["hangfleet-r0"] >= 2
+    sub = rec["replica_records"]["hangfleet-r0"]
+    assert sub is not None and sub["status"] == "ok"
+
+
+def test_fleet_hotswap_under_load_pinned_bitidentical(quorum_env,
+                                                      tmp_path):
+    """A swap control file activates model B on whichever replica claims
+    it while requests PINNED to model A keep flowing on both replicas:
+    every response stays bit-identical to A (requests bind their model
+    at claim; a swap never bleeds into pinned traffic), and the swap is
+    acknowledged in ``.swap.done.json``."""
+    _, model_b, input_b = _frozen_fixture(tmp_path, seed=11, stem="model_b")
+    mid_a = quorum_env["oracle"].model_id
+    rids = {"s00": 6, "s01": 11, "s02": 16, "s03": 5}
+    spool = str(tmp_path / "swapfleet_spool")
+    workdir = str(tmp_path / "swapfleet_work")
+    os.makedirs(spool)
+    queries = {}
+    for i, (rid, rows) in enumerate(rids.items()):
+        queries[rid] = _queries(rows, seed=300 + i)
+        submit(spool, queries[rid], rid, model_id=mid_a)
+    swap = {"model": model_b, "input": input_b, "perplexity": PERP,
+            "learning_rate": LR, "neighbors": K, "repulsion": "exact",
+            "activate": True}
+    tmp = os.path.join(spool, "swapb.swap.json.part")
+    with open(tmp, "w") as f:
+        json.dump(swap, f)
+    os.replace(tmp, os.path.join(spool, "swapb.swap.json"))
+    spec = ServeFleetSpec(
+        name="swapfleet", spool=spool, workdir=workdir,
+        serve=_serve_template(quorum_env["model"], quorum_env["input"]),
+        replicas=2, stale_ms=60000.0, run_s=240.0, poll_s=0.05,
+        backoff_base=0.05, backoff_cap=0.2,
+        env=_fleet_env(quorum_env["aot"]),
+        record=str(tmp_path / "swapfleet_record.json"))
+    rec = run_serve_fleet(spec)
+    assert rec["deadline_hit"] is False
+    _assert_exactly_once_bitidentical(quorum_env, spool, queries,
+                                      extra=["swapb.swap.done.json"])
+    with open(os.path.join(spool, "swapb.swap.done.json")) as f:
+        done = json.load(f)
+    assert done["status"] == "ok" and done["action"] == "admit"
+    subs = [s for s in rec["replica_records"].values() if s]
+    assert len(subs) == 2
+    assert sum(s["swaps"] for s in subs) == 1   # exactly one took the swap
+    swapped = next(s for s in subs if s["swaps"] == 1)
+    assert swapped["residency"]["active"] != mid_a
+    assert mid_a in swapped["residency"]["resident"]
+    # every latency record names model A — the pin held through the swap
+    for rid in rids:
+        with open(os.path.join(spool, rid + ".lat.json")) as f:
+            assert json.load(f)["model_id"] == mid_a
+
+
+# ---- the storm (ci `chaos` job: pytest -m slow -k chaos) -------------------
+
+@pytest.mark.slow
+def test_fleet_chaos_storm_mixed_faults_availability(quorum_env,
+                                                     tmp_path):
+    """Three replicas, one killed and one hung under a wider backlog:
+    availability stays 1.0 — every request reaches exactly one terminal,
+    bit-identical to serial, nothing lost, nothing double-served."""
+    rids = {f"st{i:02d}": rows for i, rows in
+            enumerate([7, 16, 9, 3, 12, 8, 15, 4])}
+    rec, spool, queries = _run_fleet(
+        quorum_env, tmp_path, "stormfleet", replicas=3,
+        fault_plans={"0": "kill@serve:seg0", "1": "hang@serve:2"},
+        stale_ms=1500.0, rids=rids, run_s=360.0, idle_s=1.0)
+    assert rec["deadline_hit"] is False
+    _assert_exactly_once_bitidentical(quorum_env, spool, queries)
+    served = 0
+    for rid in rids:
+        with open(os.path.join(spool, rid + ".lat.json")) as f:
+            lat = json.load(f)
+        assert lat["replica"] in rec["attempts"]
+        served += 1
+    lost = len(rids) - served
+    assert lost == 0 and served / (served + lost) == 1.0
+    assert rec["relaunches"] >= 1
+    for name, sub in rec["replica_records"].items():
+        assert sub is not None and sub["status"] == "ok", name

@@ -15,6 +15,10 @@ protocols, each with one blessed write primitive:
 * **artifact / AOT caches** (utils/artifacts.py, utils/aot.py): FileLock
   -guarded tmp + ``os.replace``.
 * **job/serve records** (runtime/fleet.py): ``utils/io.atomic_write``.
+* **heartbeat / claim-epoch / shed refusal** (serve/replicas.py,
+  graftquorum): ``<replica>.beat.json`` liveness, ``<id>.epoch.json``
+  claim generations (the exactly-once rename guard's counter), and the
+  ``retry_after_ms``-carrying brownout ``.err.json`` — all atomic.
 
 This analyzer declares those protocols as :class:`ProtocolSpec` rows (the
 single registry the chaos-coverage test cross-checks against
@@ -116,6 +120,24 @@ PROTOCOLS = (
         "job-record", markers=("record_path", ".record.json"),
         blessed=("atomic_write",), fault_site="job",
         doc="fleet job/serve evidence records (runtime/fleet.py)"),
+    ProtocolSpec(
+        "heartbeat", markers=("BEAT_SUFFIX", ".beat.json"),
+        blessed=("atomic_write",), fault_site="serve",
+        doc="graftquorum replica liveness: <replica>.beat.json in the "
+            "spool (seq + pid + claimed manifest) drives the dead/hung/"
+            "slow triage; swept by the supervisor at fleet exit"),
+    ProtocolSpec(
+        "claim-epoch", markers=("EPOCH_SUFFIX", ".epoch.json"),
+        blessed=("atomic_write",), fault_site="serve",
+        doc="graftquorum claim generation: <id>.epoch.json bumped under "
+            "the claim lock; the result writer's rename guard discards a "
+            "zombie's stale-epoch write (serve/replicas.py)"),
+    ProtocolSpec(
+        "shed-refusal", markers=("retry_after_ms",),
+        blessed=("atomic_write",), fault_site="serve",
+        doc="graftquorum brownout terminal: a bulk-lane .err.json refusal "
+            "carrying retry_after_ms when the backlog exceeds "
+            "TSNE_SERVE_SHED_DEPTH (runtime/admission.decide_shed)"),
 )
 
 

@@ -1343,6 +1343,9 @@ _SERVE_KEYS_FALLBACK = (
     "deadline_ms", "starve_ms", "poll_ms", "queue_ms", "compute_ms",
     "write_ms", "batch_fill", "lane", "slices", "spool", "promoted",
     "batches", "residency", "seconds",
+    # graftquorum replica/fleet fields (serve/replicas.py resolvers)
+    "replica", "epoch", "replicas", "stale_ms", "shed", "shed_depth",
+    "retry_after_ms", "redispatched",
 )
 
 _BACKTICK_KEY_RE = re.compile(r"``([A-Za-z0-9_]+)``")

@@ -35,6 +35,9 @@ from dataclasses import dataclass, replace
 ADMIT = "admit"
 DEGRADE = "admit-degraded"
 QUEUE = "queue"
+#: overload-shedding outcome (``ShedDecision.action``): the request gets
+#: a fast ``.err.json`` refusal with a retry hint instead of queueing.
+SHED = "shed"
 
 
 @dataclass(frozen=True)
@@ -103,6 +106,63 @@ def decide_residency(resident_peaks, model_id: str, peak_bytes: int,
                     f"{in_use} = {total} exceeds budget "
                     f"{int(budget_bytes)}; model refused, resident set "
                     "unchanged")
+
+
+@dataclass(frozen=True)
+class ShedDecision:
+    """One overload-shedding verdict for one spooled request."""
+
+    action: str                 # admit | shed
+    retry_after_ms: float       # client back-off hint (0 when admitted)
+    reason: str
+
+    def as_dict(self) -> dict:
+        return {"action": self.action,
+                "retry_after_ms": float(self.retry_after_ms),
+                "reason": self.reason}
+
+
+def decide_shed(backlog: int, rows: int, bucket: int, shed_depth: int,
+                deadline_ms: float) -> ShedDecision:
+    """Brownout policy for one claimed request (graftquorum): when the
+    fleet-wide pending backlog exceeds ``shed_depth``, BULK-lane requests
+    (more rows than one bucket — the lane split of ``serve/sched.py``)
+    are refused with a ``retry_after_ms`` hint instead of growing the
+    queue without bound.  Express requests are NEVER shed before bulk:
+    under brownout the fleet keeps its latency floor for small requests
+    and sheds the capacity hogs.  The retry hint scales with how far
+    over the threshold the backlog is — one deadline unit per excess
+    request, the same slack currency the scheduler's deadlines use —
+    so clients back off harder the deeper the overload."""
+    if shed_depth <= 0 or backlog <= shed_depth:
+        return ShedDecision(ADMIT, 0.0,
+                            f"backlog {backlog} within shed depth "
+                            f"{shed_depth}")
+    if rows <= int(bucket):
+        return ShedDecision(ADMIT, 0.0,
+                            f"express lane ({rows} rows <= bucket "
+                            f"{bucket}) is never shed before bulk")
+    retry_ms = float(deadline_ms) * (backlog - int(shed_depth))
+    return ShedDecision(
+        SHED, round(retry_ms, 3),
+        f"backlog {backlog} exceeds shed depth {shed_depth}: bulk "
+        f"request ({rows} rows) refused, retry in ~{round(retry_ms)}ms")
+
+
+def bounded_claim_rows(default_rows: int, bucket: int, peak_bytes: int,
+                       budget_bytes: int | None) -> int:
+    """The per-replica claim horizon, bounded by the fleet HBM budget:
+    at most ``budget // transform_peak_bytes`` buckets' worth of queue
+    depth per replica (each in-flight bucket is charged one transform
+    peak — conservative: the double-buffered tick holds at most two),
+    never below one bucket, never above ``default_rows``.  With no
+    budget the default horizon stands — the same unlimited-on-CPU
+    stance as every other admission gate here."""
+    default_rows = int(default_rows)
+    if budget_bytes is None or int(peak_bytes) <= 0:
+        return default_rows
+    depth = max(1, int(budget_bytes) // int(peak_bytes))
+    return max(int(bucket), min(default_rows, depth * int(bucket)))
 
 
 class AdmissionController:

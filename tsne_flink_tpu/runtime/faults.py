@@ -17,6 +17,15 @@ delay  ``delay@knn``           sleep ``TSNE_FAULT_DELAY_S`` seconds at the
                                site entry (latency chaos: slow a stage
                                without changing a bit of its output; the
                                sleep is a ``fault.delay`` obs span)
+hang   ``hang@serve``          block FOREVER at the site entry (a
+                               ``fault.hang`` obs span that never ends) —
+                               the process stays alive but stops making
+                               progress, which is exactly what ``delay``
+                               cannot model: a hung replica's heartbeat
+                               goes stale while its pid stays live, so
+                               the graftquorum dead/hung/slow triage is
+                               testable; only SIGKILL (the fleet
+                               supervisor's move) ends it
 ====== ======================= ==========================================
 
 Triggers: a bare integer is the Nth call of that site (1-based, default
@@ -49,15 +58,16 @@ import os
 import signal
 from dataclasses import dataclass, field
 
-KINDS = ("oom", "kill", "corrupt", "nan", "delay")
+KINDS = ("oom", "kill", "corrupt", "nan", "delay", "hang")
 SITES = ("knn", "affinities", "optimize", "checkpoint", "job", "serve")
 
-#: where in a segment each optimize-site kind fires: oom/nan/delay at
-#: segment start (so the recovery path sees the failure before any work
-#: is committed), kill at the boundary (after the checkpoint is written —
-#: the resume contract is what the kill exercises).
+#: where in a segment each optimize-site kind fires: oom/nan/delay/hang
+#: at segment start (so the recovery path sees the failure before any
+#: work is committed), kill at the boundary (after the checkpoint is
+#: written — the resume contract is what the kill exercises).
 POINT_FOR_KIND = {"oom": "start", "nan": "start", "kill": "boundary",
-                  "corrupt": "boundary", "delay": "start"}
+                  "corrupt": "boundary", "delay": "start",
+                  "hang": "start"}
 
 #: what a fleet-level ``<kind>@job:N`` clause becomes inside job N's own
 #: process (runtime/fleet.py injects it into the first attempt's plan).
@@ -161,6 +171,22 @@ def _sleep_delay(site: str) -> None:
         time.sleep(secs)
 
 
+def _hang(site: str) -> None:
+    """The ``hang@site`` payload: block forever at the site entry.  The
+    span BEGINS (so the trace shows where the process wedged) but never
+    ends — the process keeps its pid, answers signals, and makes zero
+    progress, which is the failure mode heartbeat staleness (graftquorum
+    hung-replica triage) exists to catch.  The sleep loop is
+    interruptible only by a signal; the fleet supervisor's SIGKILL is
+    the expected exit."""
+    import time
+
+    from tsne_flink_tpu.obs import trace as obtrace
+    obtrace.begin("fault.hang", cat="fault", site=site)
+    while True:
+        time.sleep(3600.0)
+
+
 def _flip_bit(path: str) -> None:
     """Flip one bit in the middle of ``path`` — the corrupt@ payload.
     Deterministic (fixed offset), and deliberately NOT a truncation: a
@@ -213,6 +239,8 @@ class FaultInjector:
                 _flip_bit(path)
             if f.kind == "delay":
                 _sleep_delay(site)
+            if f.kind == "hang":
+                _hang(site)
             if f.kind == "nan":
                 result = f
         return result

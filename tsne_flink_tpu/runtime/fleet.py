@@ -31,6 +31,11 @@ one HBM budget (ROADMAP item 4).  This module is that scheduler:
 * **shared caches**: jobs share one content-addressed artifact cache and
   one AOT executable cache; writes are serialized per cache key by
   ``utils/locks.FileLock``;
+* **replicated serving** (graftquorum, ``serve/replicas.py``): ``--serve-fleet
+  spec.json`` supervises N ``--serve`` daemons against ONE shared spool —
+  heartbeat triage (dead / hung / slow), claim-epoch exactly-once
+  re-dispatch, bulk-lane overload shedding; chaos rides each replica's
+  own spec ``fault_plan``, first attempt only;
 * **observability**: the fleet runs under a ``fleet.run`` span with
   launch/exit/admit/reject/retry instants, counts
   ``fleet.admission_rejections`` / ``fleet.preemptions`` /
@@ -354,6 +359,10 @@ class ServeSpec:
     deadline_ms: float | None = None
     starve_ms: float | None = None
     poll_max_ms: float | None = None
+    # graftquorum: replica identity + fleet triage/brownout knobs
+    replica: str | None = None     # replica name (None = solo daemon)
+    shed_depth: int | None = None  # None = TSNE_SERVE_SHED_DEPTH
+    stale_ms: float | None = None  # None = TSNE_REPLICA_STALE_MS
     models: list | None = None     # extra resident models: [{"model":
     #   ckpt, "input": npy, "perplexity"?, "learning_rate"?, "metric"?,
     #   "neighbors"?, "repulsion"?, "activate"?: bool}, ...]
@@ -422,7 +431,10 @@ def run_serve(spec: ServeSpec) -> dict:
                              sched=spec.sched,
                              deadline_ms=spec.deadline_ms,
                              starve_ms=spec.starve_ms,
-                             poll_max_ms=spec.poll_max_ms)
+                             poll_max_ms=spec.poll_max_ms,
+                             replica=spec.replica,
+                             shed_depth=spec.shed_depth,
+                             stale_ms=spec.stale_ms)
         for extra in (spec.models or []):
             from tsne_flink_tpu.serve.model import frozen_from_files
             daemon.load_model(
@@ -458,19 +470,133 @@ def run_serve(spec: ServeSpec) -> dict:
     return record
 
 
+@dataclass
+class ServeFleetSpec:
+    """N replica daemons against ONE shared spool, JSON-serializable —
+    the graftquorum supervisor contract (``serve/replicas.py``).  The
+    ``serve`` dict is a :class:`ServeSpec` template (model/input/bucket/
+    scheduler knobs); the supervisor stamps per-replica ``name`` /
+    ``replica`` / ``spool`` / ``record`` fields onto it and writes TWO
+    spec files per replica — the chaos one (``fault_plans`` entry, first
+    attempt) and the clean one (every relaunch)."""
+
+    name: str
+    spool: str
+    workdir: str                   # per-replica specs / logs / records
+    serve: dict = field(default_factory=dict)
+    replicas: int | None = None    # None = TSNE_SERVE_REPLICAS
+    stale_ms: float | None = None  # None = TSNE_REPLICA_STALE_MS
+    shed_depth: int | None = None  # None = TSNE_SERVE_SHED_DEPTH
+    run_s: float = 120.0           # supervisor deadline (stragglers die)
+    poll_s: float = 0.05
+    max_attempts: int = 3          # spawns per replica, incl. the first
+    backoff_base: float | None = None
+    backoff_cap: float | None = None
+    fault_plans: dict = field(default_factory=dict)  # {"0"|name: plan}
+    env: dict = field(default_factory=dict)          # extra child env
+    record: str = ""               # fleet-record JSON (written at exit)
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServeFleetSpec":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.as_dict(), f, indent=2)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ServeFleetSpec":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+
+def run_serve_fleet(spec: ServeFleetSpec) -> dict:
+    """The graftquorum supervisor process: write per-replica chaos +
+    clean :class:`ServeSpec` files, spawn N ``--serve`` children against
+    the shared spool, and run the heartbeat-triage / re-dispatch /
+    relaunch loop (``serve/replicas.ServeFleet``) until the spool drains
+    or ``run_s`` elapses.  No JAX in this process — the supervisor is
+    pure process/file plumbing, so it survives anything a replica does
+    to its accelerator."""
+    from tsne_flink_tpu.serve import replicas as quorum
+
+    os.makedirs(spec.workdir, exist_ok=True)
+    os.makedirs(spec.spool, exist_ok=True)
+    n = quorum.pick_serve_replicas(spec.replicas)
+    members = []
+    for i in range(n):
+        name = f"{spec.name}-r{i}"
+        plan = (spec.fault_plans.get(str(i))
+                or spec.fault_plans.get(name))
+        base = dict(spec.serve)
+        base.update(name=name, spool=spec.spool, replica=name,
+                    shed_depth=spec.shed_depth, stale_ms=spec.stale_ms,
+                    record=os.path.join(spec.workdir,
+                                        name + ".record.json"))
+        clean = ServeSpec.from_dict({**base, "fault_plan": None})
+        clean_path = clean.save(
+            os.path.join(spec.workdir, name + ".clean.spec.json"))
+        chaos_path = clean_path
+        if plan:
+            chaos = ServeSpec.from_dict({**base, "fault_plan": str(plan)})
+            chaos_path = chaos.save(
+                os.path.join(spec.workdir, name + ".spec.json"))
+        members.append(quorum._Replica(
+            name, chaos_path, clean_spec_path=clean_path,
+            log_path=os.path.join(spec.workdir, name + ".log")))
+    fleet = quorum.ServeFleet(spec.spool, members,
+                              stale_ms=spec.stale_ms, poll_s=spec.poll_s,
+                              max_attempts=spec.max_attempts,
+                              env=spec.env,
+                              backoff_base=spec.backoff_base,
+                              backoff_cap=spec.backoff_cap)
+    record = {"name": spec.name, "spool": spec.spool,
+              "fault_plans": dict(spec.fault_plans)}
+    record.update(fleet.run(spec.run_s))
+    summaries = {}
+    for rep in members:
+        rec_path = os.path.join(spec.workdir, rep.name + ".record.json")
+        try:
+            with open(rec_path, encoding="utf-8") as f:
+                summaries[rep.name] = json.load(f)
+        except (OSError, ValueError):
+            summaries[rep.name] = None   # died before its record landed
+    record["replica_records"] = summaries
+    if spec.record:
+        from tsne_flink_tpu.utils.io import atomic_write
+
+        def write(tmp):
+            with open(tmp, "w") as f:
+                json.dump(record, f, indent=2)
+        atomic_write(spec.record, write)
+    return record
+
+
 def main(argv=None) -> int:
     """Subprocess entry: ``python -m tsne_flink_tpu.runtime.fleet --job
-    spec.json`` (one embed job) or ``--serve spec.json`` (the graftserve
-    daemon) — the isolation boundary fleet processes run behind."""
+    spec.json`` (one embed job), ``--serve spec.json`` (the graftserve
+    daemon) or ``--serve-fleet spec.json`` (the graftquorum replica
+    supervisor) — the isolation boundary fleet processes run behind."""
     import argparse
     p = argparse.ArgumentParser(prog="tsne-fleet-job")
     p.add_argument("--job", help="JobSpec JSON path")
     p.add_argument("--serve", help="ServeSpec JSON path (daemon mode)")
+    p.add_argument("--serve-fleet", dest="serve_fleet",
+                   help="ServeFleetSpec JSON path (replica supervisor)")
     args = p.parse_args(argv)
-    if bool(args.job) == bool(args.serve):
-        p.error("exactly one of --job / --serve is required")
+    if sum(map(bool, (args.job, args.serve, args.serve_fleet))) != 1:
+        p.error("exactly one of --job / --serve / --serve-fleet "
+                "is required")
     if args.serve:
         run_serve(ServeSpec.load(args.serve))
+        return 0
+    if args.serve_fleet:
+        run_serve_fleet(ServeFleetSpec.load(args.serve_fleet))
         return 0
     run_job(JobSpec.load(args.job))
     return 0

@@ -55,6 +55,20 @@ the same "predict, then commit" contract the fleet scheduler enforces
 per job.  The spool poll backs off adaptively while idle: the interval
 starts at ``TSNE_SERVE_TICK_S`` after any work and doubles per empty
 scan up to ``TSNE_SERVE_POLL_MAX_MS``.
+
+**Replica mode** (graftquorum, ``serve/replicas.py``): a daemon given a
+``replica`` name runs as one of N against a SHARED spool — it writes a
+``<replica>.beat.json`` heartbeat before every tick, stamps each claim
+lock with its replica name + a claim epoch (bumped under the lock via
+the ``<id>.epoch.json`` sidecar), and every result/refusal write passes
+the epoch rename guard: the bytes land in an epoch-suffixed tmp and the
+rename only happens while the lock body still names this pid + epoch,
+so a zombie replica's late write is discarded and re-dispatched
+requests stay exactly-once.  The claim stale-break folds in holder
+pid-aliveness + heartbeat freshness (dead = break now, alive-and-
+beating = never, anonymous = age rule), and under backlog past
+``TSNE_SERVE_SHED_DEPTH`` bulk-lane requests are shed with a
+``retry_after_ms`` refusal — express is never shed before bulk.
 """
 
 from __future__ import annotations
@@ -68,6 +82,9 @@ import numpy as np
 from tsne_flink_tpu.obs import trace as obtrace
 from tsne_flink_tpu.obs.trace import walltime
 from tsne_flink_tpu.runtime import faults
+from tsne_flink_tpu.runtime.admission import (SHED, bounded_claim_rows,
+                                              decide_shed)
+from tsne_flink_tpu.serve import replicas as quorum
 from tsne_flink_tpu.serve.sched import (MicroBatcher, Request,
                                         pick_poll_max_ms,
                                         pick_serve_deadline_ms,
@@ -75,7 +92,7 @@ from tsne_flink_tpu.serve.sched import (MicroBatcher, Request,
                                         pick_serve_starve_ms)
 from tsne_flink_tpu.utils.env import env_float, env_int, env_str
 from tsne_flink_tpu.utils.io import atomic_write
-from tsne_flink_tpu.utils.locks import FileLock
+from tsne_flink_tpu.utils.locks import FileLock, read_lock_payload
 
 REQ_SUFFIX = ".req.npz"
 RES_SUFFIX = ".res.npz"
@@ -128,6 +145,24 @@ def _req_id(req_path: str) -> str:
     return os.path.basename(req_path)[:-len(REQ_SUFFIX)]
 
 
+class StaleClaim(Exception):
+    """The claim-epoch rename guard's verdict: the claim lock no longer
+    names this pid + this claim epoch — the request was stale-broken and
+    re-dispatched while we computed.  Raised from INSIDE the result
+    writer callback (after the bytes hit the tmp file, before the
+    rename), so ``atomic_write`` aborts and unlinks the tmp: a zombie's
+    late write never becomes a terminal file and the request stays
+    exactly-once."""
+
+
+def _claim_current(lock: FileLock, epoch: int) -> bool:
+    """True while the claim lock body still names THIS pid holding THIS
+    claim epoch (the stamp ``_claim`` wrote at acquisition)."""
+    claim = read_lock_payload(lock.path)
+    return (claim.get("pid") == str(os.getpid())
+            and claim.get("epoch") == str(int(epoch)))
+
+
 class ServeDaemon:
     """The warm process: models resident, executables compiled, spool
     polled (with adaptive backoff) until stopped or idle past
@@ -141,7 +176,10 @@ class ServeDaemon:
                  budget_bytes=None, sched: str | None = None,
                  deadline_ms: float | None = None,
                  starve_ms: float | None = None,
-                 poll_max_ms: float | None = None):
+                 poll_max_ms: float | None = None,
+                 replica: str | None = None,
+                 shed_depth: int | None = None,
+                 stale_ms: float | None = None):
         from tsne_flink_tpu.serve.transform import (pick_serve_bucket,
                                                     pick_transform_eta,
                                                     pick_transform_iters)
@@ -168,15 +206,6 @@ class ServeDaemon:
                                     starve_s=self.starve_ms / 1e3)
         self.inflight: list = []   # dispatched, unmaterialized batches
         self.depth = 2             # double-buffered tick
-        # sched-mode claim horizon: how far into the spool the scheduler
-        # may look for reordering.  Unlike ``max_batch`` (which bounds
-        # PER-TICK device rows, an HBM concern), claimed-but-unpacked
-        # requests are host numpy + a held lock — the only device work
-        # is one bucket at a time — so the horizon is wide: a small
-        # request deep in the backlog cannot overtake work it was never
-        # claimed into.  16x max_batch bounds host RAM against an
-        # unbounded spool flood.
-        self.claim_rows = 16 * self.max_batch
         self._claimed: dict[str, Request] = {}  # held across sched ticks
         self._poll_s = self.tick_s
         self._batches = 0
@@ -188,6 +217,28 @@ class ServeDaemon:
         self.served = 0
         self.residency_events: list[dict] = []
         self.admission = self._admit(budget_bytes)
+        # sched-mode claim horizon: how far into the spool the scheduler
+        # may look for reordering.  Unlike ``max_batch`` (which bounds
+        # PER-TICK device rows, an HBM concern), claimed-but-unpacked
+        # requests are host numpy + a held lock — the only device work
+        # is one bucket at a time — so the horizon is wide: a small
+        # request deep in the backlog cannot overtake work it was never
+        # claimed into.  16x max_batch bounds host RAM against an
+        # unbounded spool flood, additionally bounded by queue depth x
+        # transform peak against the fleet HBM budget (graftquorum
+        # per-replica admission).
+        self.claim_rows = bounded_claim_rows(
+            16 * self.max_batch, self.bucket,
+            self.admission["peak_bytes"], self.admission["budget_bytes"])
+        # graftquorum: replica identity (None = solo daemon, no beats),
+        # heartbeat staleness bound (also drives the claim stale-break
+        # verdict), brownout threshold, and the fleet counters
+        self.replica = str(replica) if replica else None
+        self.stale_ms = quorum.pick_replica_stale_ms(stale_ms)
+        self.shed_depth = quorum.pick_shed_depth(shed_depth)
+        self._beat_seq = 0
+        self.shed = 0
+        self.redispatched = 0
 
     @property
     def model(self):
@@ -288,21 +339,54 @@ class ServeDaemon:
         return sorted(os.path.join(self.spool, n) for n in names
                       if n.endswith(REQ_SUFFIX))
 
+    def _beat(self) -> None:
+        """graftquorum heartbeat: one atomic ``<replica>.beat.json`` per
+        tick — monotonic seq, pid, claimed-request manifest.  Written
+        BEFORE the tick body, so a tick that hangs leaves a beat that
+        ages past ``stale_ms`` while the pid stays alive: exactly the
+        evidence the supervisor's hung-triage (and the claim-protecting
+        stale verdict) keys on.  Solo daemons (no replica name) write no
+        beat; the triage then falls back to pid-aliveness + lock age."""
+        if not self.replica:
+            return
+        self._beat_seq += 1
+        quorum.write_beat(self.spool, self.replica, self._beat_seq,
+                          [r.rid for r in self._claimed.values()])
+
+    def _req_lock(self, req_path: str) -> FileLock:
+        """A claim-style lock for one request: the payload names this
+        replica (the supervisor's claim-sweep key; the epoch is stamped
+        after acquisition), and the stale-break verdict folds in holder
+        pid-aliveness + heartbeat freshness — a DEAD holder's claim
+        breaks immediately, a slow-but-alive holder's claim is NEVER
+        broken, and only anonymous holders fall back to the plain
+        ``TSNE_LOCK_STALE_S`` age rule."""
+        spool, stale_s = self.spool, self.stale_ms / 1e3
+
+        def stale(path, age):
+            return quorum.claim_stale_verdict(path, age, spool=spool,
+                                              replica_stale_s=stale_s)
+        payload = ({"replica": self.replica} if self.replica
+                   else {"claim": "serve"})
+        return FileLock(req_path + ".lock", payload=payload,
+                        stale_fn=stale)
+
     def _claim(self, req_path: str):
-        """The request's (lock, rows, model_id) if we hold its lock and
-        it is unserved, else None.  A torn/unreadable file stays
-        claimed-by-nobody until its writer finishes the rename (writes
-        are atomic, so this only means 'not ours this tick')."""
-        if os.path.exists(os.path.join(
-                self.spool, _req_id(req_path) + RES_SUFFIX)):
+        """The request's (lock, rows, model_id, claim epoch) if we hold
+        its lock and it is unserved, else None.  A torn/unreadable file
+        stays claimed-by-nobody until its writer finishes the rename
+        (writes are atomic, so this only means 'not ours this tick')."""
+        rid = _req_id(req_path)
+        if os.path.exists(os.path.join(self.spool, rid + RES_SUFFIX)):
             # served before a crash could delete the request: finish the
             # delete and move on (the result is the done marker)
             try:
                 os.remove(req_path)
             except OSError:
                 pass
+            quorum.clear_epoch(self.spool, rid)
             return None
-        lock = FileLock(req_path + ".lock")
+        lock = self._req_lock(req_path)
         # graftlint: disable=resource-hygiene -- claim hand-off: the
         # lock deliberately OUTLIVES this function (held claim-to-result
         # is the spool crash story); it is returned to the caller, every
@@ -312,40 +396,81 @@ class ServeDaemon:
         if not lock.acquire(timeout_s=0.0):
             return None
         try:
+            # the claim generation: bumped under the lock, stamped into
+            # the lock body — the writers' rename guard compares the two
+            epoch = quorum.bump_epoch(self.spool, rid, lock)
+            lock.write_payload({"epoch": epoch})
+            if epoch > 1:
+                # somebody claimed this before us and never finished:
+                # a broken (dead/hung) claim re-dispatched to us
+                self.redispatched += 1
             with np.load(req_path) as z:
                 x = np.asarray(z["x"])
                 mid = (str(z["model"].item()) if "model" in z.files
                        else None)
-                return lock, x, mid
+                return lock, x, mid, epoch
         except (OSError, KeyError, ValueError):
             lock.release()
             return None
 
-    def _fail(self, req_path: str, lock: FileLock, reason: str) -> None:
-        """Refuse one request (unknown model, wrong width): atomic
-        ``.err.json`` so the client stops waiting, request deleted."""
+    def _fail(self, req_path: str, lock: FileLock, reason: str, *,
+              epoch: int = 0, shed: bool = False,
+              retry_after_ms: float | None = None) -> None:
+        """Refuse one request (unknown model, wrong width — or a shed
+        verdict under brownout, which adds ``retry_after_ms``): atomic
+        ``.err.json`` so the client stops waiting, request deleted.  The
+        claim-epoch rename guard rides the refusal write too: a zombie's
+        late refusal for a stale claim is discarded, never a second
+        terminal."""
         rid = _req_id(req_path)
 
         def write_err(tmp):
+            out = {"req": rid, "error": reason}
+            if shed:
+                out["shed"] = True
+                out["retry_after_ms"] = float(retry_after_ms or 0.0)
             with open(tmp, "w") as f:
-                json.dump({"req": rid, "error": reason}, f)
-        atomic_write(os.path.join(self.spool, rid + ERR_SUFFIX), write_err)
+                json.dump(out, f)
+            if epoch and not _claim_current(lock, epoch):
+                raise StaleClaim(rid)
+        try:
+            atomic_write(os.path.join(self.spool, rid + ERR_SUFFIX),
+                         write_err, tag=f"e{int(epoch)}")
+        except StaleClaim:
+            lock.release()   # ownership-checked: a stolen claim survives
+            return
         try:
             os.remove(req_path)
         except OSError:
             pass
+        quorum.clear_epoch(self.spool, rid)
         lock.release()
-        self.failed += 1
+        if shed:
+            self.shed += 1
+        else:
+            self.failed += 1
 
     def _finish(self, req_path: str, lock: FileLock, y: np.ndarray,
-                seconds: float, *, model_id: str | None = None) -> None:
+                seconds: float, *, model_id: str | None = None,
+                epoch: int = 0) -> None:
         rid = _req_id(req_path)
         res = os.path.join(self.spool, rid + RES_SUFFIX)
 
         def write_res(tmp):
             with open(tmp, "wb") as f:
                 np.savez(f, y=y)
-        atomic_write(res, write_res)
+            # the rename guard: the bytes are in the epoch-suffixed tmp,
+            # but the rename onto the result path only happens while the
+            # claim lock still names THIS pid + epoch — a zombie whose
+            # claim was broken and re-dispatched aborts here, its tmp is
+            # unlinked, and the live claimant's result stands alone
+            if epoch and not _claim_current(lock, epoch):
+                raise StaleClaim(rid)
+        try:
+            atomic_write(res, write_res, tag=f"e{int(epoch)}")
+        except StaleClaim:
+            lock.release()   # ownership-checked: a stolen claim survives
+            return
 
         def write_lat(tmp):
             with open(tmp, "w") as f:
@@ -353,12 +478,15 @@ class ServeDaemon:
                            "seconds": round(float(seconds), 6),
                            "bucket": self.bucket, "iters": self.iters,
                            "eta": self.eta,
-                           "model_id": model_id or self.active_id}, f)
+                           "model_id": model_id or self.active_id,
+                           "epoch": int(epoch),
+                           "replica": self.replica}, f)
         atomic_write(os.path.join(self.spool, rid + LAT_SUFFIX), write_lat)
         try:
             os.remove(req_path)
         except OSError:
             pass
+        quorum.clear_epoch(self.spool, rid)
         lock.release()
         self.latencies_s.append(float(seconds))
         self.served += 1
@@ -441,19 +569,30 @@ class ServeDaemon:
         if inj:
             inj.fire("serve")  # oom / delay / nan rehearsal at tick start
         self._control_pass()
-        claimed: list[tuple[str, FileLock, np.ndarray, str]] = []
+        claimed: list[tuple[str, FileLock, np.ndarray, str, int]] = []
         rows = 0
-        for req_path in self._pending():
+        pending = self._pending()
+        backlog = len(pending)   # the fleet-wide shed signal: the spool
+        for req_path in pending:
             if rows >= self.max_batch:
                 break
             got = self._claim(req_path)
             if got is None:
                 continue
-            lock, x, mid = got
-            if mid is not None and mid not in self.models:
-                self._fail(req_path, lock, f"model {mid} not resident")
+            lock, x, mid, epoch = got
+            verdict = decide_shed(backlog, int(x.shape[0]), self.bucket,
+                                  self.shed_depth, self.deadline_ms)
+            if verdict.action == SHED:
+                self._fail(req_path, lock, verdict.reason, epoch=epoch,
+                           shed=True,
+                           retry_after_ms=verdict.retry_after_ms)
                 continue
-            claimed.append((req_path, lock, x, mid or self.active_id))
+            if mid is not None and mid not in self.models:
+                self._fail(req_path, lock, f"model {mid} not resident",
+                           epoch=epoch)
+                continue
+            claimed.append((req_path, lock, x, mid or self.active_id,
+                            epoch))
             rows += int(x.shape[0])
         if not claimed:
             return 0
@@ -462,19 +601,20 @@ class ServeDaemon:
             with obtrace.span("serve.drain", cat="serve", requests=len(
                     claimed), rows=rows) as sp:
                 order: list[str] = []
-                for _, _, _, mid in claimed:
+                for _, _, _, mid, _ in claimed:
                     if mid not in order:
                         order.append(mid)
                 ys, offs = {}, {}
                 for mid in order:
                     xs = np.concatenate(
-                        [x for _, _, x, m in claimed if m == mid], axis=0)
+                        [x for _, _, x, m, _ in claimed if m == mid],
+                        axis=0)
                     ys[mid] = transform(self.models[mid], xs,
                                         bucket=self.bucket,
                                         iters=self.iters, eta=self.eta)
                     offs[mid] = 0
             per_req = sp.seconds / len(claimed)
-            for req_path, lock, x, mid in claimed:
+            for req_path, lock, x, mid, epoch in claimed:
                 b = int(x.shape[0])
                 if inj:
                     # kill@serve lands HERE: after compute, before this
@@ -483,12 +623,12 @@ class ServeDaemon:
                     inj.fire("serve", seg=self.served, point="boundary")
                 off = offs[mid]
                 self._finish(req_path, lock, ys[mid][off:off + b], per_req,
-                             model_id=mid)
+                             model_id=mid, epoch=epoch)
                 offs[mid] = off + b
                 done += 1
             claimed = []
         finally:
-            for _, lock, _, _ in claimed:
+            for _, lock, _, _, _ in claimed:
                 lock.release()  # crash path: unserved claims unlock now
         return done
 
@@ -502,7 +642,9 @@ class ServeDaemon:
         earlier batches compute on the device — the spool I/O half of
         the pipelined tick."""
         new = 0
-        for req_path in self._pending():
+        pending = self._pending()
+        backlog = len(pending)   # the fleet-wide shed signal: the spool
+        for req_path in pending:
             if req_path in self._claimed:
                 continue   # ours already, riding the batcher
             if self.batcher.pending_rows() >= self.claim_rows:
@@ -510,9 +652,17 @@ class ServeDaemon:
             got = self._claim(req_path)
             if got is None:
                 continue
-            lock, x, mid = got
+            lock, x, mid, epoch = got
+            verdict = decide_shed(backlog, int(x.shape[0]), self.bucket,
+                                  self.shed_depth, self.deadline_ms)
+            if verdict.action == SHED:
+                self._fail(req_path, lock, verdict.reason, epoch=epoch,
+                           shed=True,
+                           retry_after_ms=verdict.retry_after_ms)
+                continue
             if mid is not None and mid not in self.models:
-                self._fail(req_path, lock, f"model {mid} not resident")
+                self._fail(req_path, lock, f"model {mid} not resident",
+                           epoch=epoch)
                 continue
             bound = mid or self.active_id
             model = self.models[bound]
@@ -520,7 +670,7 @@ class ServeDaemon:
             if xd.ndim != 2 or xd.shape[1] != int(model.x.shape[1]):
                 self._fail(req_path, lock,
                            f"queries must be [B, {int(model.x.shape[1])}],"
-                           f" got {tuple(xd.shape)}")
+                           f" got {tuple(xd.shape)}", epoch=epoch)
                 continue
             # .dtype, never a device slice: nothing on the claim path may
             # touch the device (a [1] gather would compile mid-drain)
@@ -531,7 +681,7 @@ class ServeDaemon:
                           seq=self.batcher.next_seq(), bucket=self.bucket,
                           out_width=int(model.y.shape[1]),
                           out_dtype=np.dtype(model.y.dtype),
-                          poll_ms=self._poll_s * 1e3)
+                          poll_ms=self._poll_s * 1e3, epoch=epoch)
             self._claimed[req_path] = req
             if req.rows == 0:
                 # degenerate empty request: finish without a batch
@@ -607,7 +757,15 @@ class ServeDaemon:
         def write_res(tmp):
             with open(tmp, "wb") as f:
                 np.savez(f, y=req.out)
-        atomic_write(res, write_res)
+            # the claim-epoch rename guard — see ``_finish``
+            if req.epoch and not _claim_current(req.lock, req.epoch):
+                raise StaleClaim(req.rid)
+        try:
+            atomic_write(res, write_res, tag=f"e{int(req.epoch)}")
+        except StaleClaim:
+            req.lock.release()
+            self._claimed.pop(req.path, None)
+            return
         write_ms = (walltime() - t_w0) * 1e3
         first = req.first_dispatch if req.first_dispatch else req.arrival
         comp = req.compute_done if req.compute_done else first
@@ -625,7 +783,9 @@ class ServeDaemon:
                "write_ms": round(write_ms, 3),
                "deadline_ms": self.deadline_ms,
                "starve_ms": self.starve_ms,
-               "poll_ms": round(req.poll_ms, 3)}
+               "poll_ms": round(req.poll_ms, 3),
+               "epoch": int(req.epoch),
+               "replica": self.replica}
 
         def write_lat(tmp):
             with open(tmp, "w") as f:
@@ -636,6 +796,7 @@ class ServeDaemon:
             os.remove(req.path)
         except OSError:
             pass
+        quorum.clear_epoch(self.spool, req.rid)
         req.lock.release()
         self._claimed.pop(req.path, None)
         self.latencies_s.append(float(seconds))
@@ -706,6 +867,7 @@ class ServeDaemon:
         try:
             while max_ticks is None or ticks < max_ticks:
                 ticks += 1
+                self._beat()   # graftquorum: BEFORE the (hangable) tick
                 if self.sched == "on":
                     n = self._sched_tick()
                     progress = self._progress
@@ -768,6 +930,11 @@ class ServeDaemon:
                 "promotions": self.batcher.promotions,
                 "swaps": self._swaps,
                 "failed": self.failed,
+                "replica": self.replica,
+                "stale_ms": self.stale_ms,
+                "shed": self.shed,
+                "shed_depth": self.shed_depth,
+                "redispatched": self.redispatched,
                 "residency": self._residency_summary()}
 
     def _residency_summary(self) -> dict:

@@ -1,0 +1,490 @@
+"""graftquorum — N serve-daemon replicas over ONE spool, supervised.
+
+The spool protocol (serve/daemon.py) already makes a single daemon
+crash-safe: requests are durable files, claims are O_EXCL locks, results
+land atomically, and per-row independence of the transform makes any
+packing bit-identical to serial.  This module adds what a FLEET of
+daemons needs on top — the three layers that turn "a daemon" into "a
+replicated service":
+
+* **Failure detection.**  Every replica writes ``<replica>.beat.json``
+  into the spool each tick (monotonic ``seq`` + pid + the manifest of
+  requests it currently holds claims on, all via ``atomic_write``).  The
+  supervisor triages each replica as
+
+  ========= ======================================== ==================
+  state     evidence                                 action
+  ========= ======================================== ==================
+  dead      pid gone                                 break its claims
+                                                     NOW, relaunch with
+                                                     PR-8 backoff
+  hung      pid alive, beat older than               SIGKILL, then the
+            ``TSNE_REPLICA_STALE_MS``                dead path
+  slow      pid alive, beat fresh                    leave it alone
+  ========= ======================================== ==================
+
+  and the SAME triage drives the claim stale-break inside every daemon
+  (:func:`claim_stale_verdict` rides ``FileLock.stale_fn``), so a
+  GC-pausing replica that still beats is never double-served — lock age
+  alone no longer breaks a live holder's claim.
+* **Exactly-once re-dispatch.**  Each claim carries an epoch: a
+  ``<id>.epoch.json`` sidecar (bumped atomically under the claim lock,
+  deleted with the request at its terminal) plus the same epoch stamped
+  into the lock payload.  When a dead replica's claim is broken the
+  request simply returns to the spool — the next claimant reads epoch N
+  and claims at N+1 — and a zombie's LATE result write is discarded by
+  the rename guard in ``serve/daemon.py``: the bytes land in an
+  epoch-suffixed tmp, and the rename onto ``.res.npz`` only happens if
+  the lock body still names the writer's pid + epoch.  Every request
+  reaches exactly one terminal, bit-identical to an unfailed serial run.
+* **Overload shedding.**  ``runtime/admission.decide_shed``: when the
+  fleet-wide backlog (the shared spool's pending count) exceeds
+  ``TSNE_SERVE_SHED_DEPTH``, bulk-lane requests get a fast
+  ``.err.json`` refusal carrying ``retry_after_ms`` instead of
+  unbounded queue growth; express-lane requests are never shed before
+  bulk.  The per-replica claim horizon is additionally bounded by
+  queue-depth x ``transform_peak_bytes`` against the fleet HBM budget
+  (``runtime/admission.bounded_claim_rows``).
+
+:class:`ServeFleet` is the supervisor loop ``runtime/fleet.py
+--serve-fleet`` runs: spawn N ``--serve`` child processes against the
+shared spool, poll their heartbeats, SIGKILL the hung, break the dead
+replicas' claims, relaunch with deterministic backoff
+(``runtime/supervisor.backoff_seconds``), and stop when the spool is
+drained and every child has exited.  Chaos faults ride each replica's
+OWN spec ``fault_plan`` and apply to its FIRST attempt only (same
+chaos-on-attempt-1 contract as the fleet job scheduler), so a killed
+replica's relaunch runs clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+from tsne_flink_tpu.obs import trace as obtrace
+from tsne_flink_tpu.obs.trace import walltime
+from tsne_flink_tpu.utils.env import env_float, env_int
+from tsne_flink_tpu.utils.io import atomic_write
+from tsne_flink_tpu.utils.locks import read_lock_payload
+
+#: per-replica heartbeat file in the spool (supervisor-owned: swept at
+#: the end of a fleet run so a drained spool holds terminals only)
+BEAT_SUFFIX = ".beat.json"
+
+#: per-request claim-epoch sidecar (claimant-owned: bumped under the
+#: claim lock, deleted with the request when its terminal lands)
+EPOCH_SUFFIX = ".epoch.json"
+
+#: the claim-lock suffix chain the supervisor sweeps when breaking a
+#: dead replica's claims
+CLAIM_LOCK_SUFFIX = ".req.npz.lock"
+
+
+# ---- knob resolvers (policy-recorded) ---------------------------------------
+
+def pick_serve_replicas(n: int | None = None) -> int:
+    """Replica count of the serve fleet: the explicit argument, else
+    ``TSNE_SERVE_REPLICAS``.  Recorded on the fleet record and the
+    bench ``serve_fleet`` block as ``replicas``."""
+    got = int(n) if n is not None else int(env_int("TSNE_SERVE_REPLICAS"))
+    if got < 1:
+        raise ValueError(f"replica count must be >= 1, got {got}")
+    return got
+
+
+def pick_replica_stale_ms(ms: float | None = None) -> float:
+    """Heartbeat staleness bound of the dead/hung/slow triage: the
+    explicit argument, else ``TSNE_REPLICA_STALE_MS``.  A replica whose
+    beat is older than this while its pid lives is HUNG (supervisor
+    SIGKILLs it); a fresher beat marks it merely slow and protects its
+    claims from the stale-break.  Recorded on the serve summary as
+    ``stale_ms``."""
+    got = float(ms) if ms is not None else float(
+        env_float("TSNE_REPLICA_STALE_MS"))
+    if got <= 0:
+        raise ValueError(f"replica stale bound must be > 0 ms, got {got}")
+    return got
+
+
+def pick_shed_depth(depth: int | None = None) -> int:
+    """Brownout threshold: when the fleet-wide pending backlog exceeds
+    this many requests, bulk-lane claims are refused with a
+    ``retry_after_ms`` hint (express is never shed before bulk).  The
+    explicit argument, else ``TSNE_SERVE_SHED_DEPTH``; 0 disables
+    shedding.  Recorded on the serve summary as ``shed_depth`` (and
+    refusal counts as ``shed``)."""
+    got = int(depth) if depth is not None else int(
+        env_int("TSNE_SERVE_SHED_DEPTH"))
+    if got < 0:
+        raise ValueError(f"shed depth must be >= 0, got {got}")
+    return got
+
+
+# ---- heartbeats -------------------------------------------------------------
+
+def beat_path(spool: str, replica: str) -> str:
+    return os.path.join(spool, replica + BEAT_SUFFIX)
+
+
+def write_beat(spool: str, replica: str, seq: int, claimed) -> str:
+    """One heartbeat: monotonic ``seq``, the writer's pid, the sampled
+    wall clock, and the manifest of request ids this replica currently
+    holds claims on (the supervisor's post-mortem of a dead replica
+    starts here).  Atomic like every spool write."""
+    path = beat_path(spool, replica)
+    payload = {"replica": replica, "pid": os.getpid(), "seq": int(seq),
+               "t": walltime(), "claimed": sorted(claimed)}
+
+    def write(tmp):
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+    atomic_write(path, write)
+    return path
+
+
+def read_beat(spool: str, replica: str) -> dict | None:
+    """The replica's last heartbeat, or None when absent/torn."""
+    if not replica:
+        return None
+    try:
+        with open(beat_path(spool, replica), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def clear_beats(spool: str) -> None:
+    """Sweep heartbeat files (fleet-run epilogue: a drained spool holds
+    terminals only — the zero-litter contract the chaos tests pin)."""
+    try:
+        names = os.listdir(spool)
+    except OSError:
+        return
+    for name in names:
+        if name.endswith(BEAT_SUFFIX):
+            try:
+                os.remove(os.path.join(spool, name))
+            except OSError:
+                pass
+
+
+def pid_alive(pid: int) -> bool:
+    """True when ``pid`` exists (signal 0 probe; EPERM still means
+    alive)."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (OSError, PermissionError):
+        return True
+    return True
+
+
+def claim_stale_verdict(lock_path: str, age: float, *, spool: str,
+                        replica_stale_s: float):
+    """The dead/hung/slow triage applied to one claim lock — the
+    ``FileLock.stale_fn`` the daemon installs on every request claim.
+
+    * holder pid GONE -> True (dead: break immediately, any age);
+    * holder pid alive and its replica's heartbeat (same pid) fresher
+      than ``replica_stale_s`` -> False (slow-but-alive: NEVER broken,
+      however old the lock — the zombie-write hazard the claim epoch
+      then closes is the only residual race);
+    * otherwise -> None (anonymous or beat-stale holder: the plain
+      ``TSNE_LOCK_STALE_S`` age rule decides, the pre-quorum behavior).
+    """
+    claim = read_lock_payload(lock_path)
+    pid_s = str(claim.get("pid", ""))
+    if not pid_s.isdigit():
+        return None                      # torn/anonymous: age rule
+    if not pid_alive(int(pid_s)):
+        return True                      # dead holder: break NOW
+    beat = read_beat(spool, claim.get("replica", ""))
+    if beat is not None and str(beat.get("pid")) == pid_s:
+        if walltime() - float(beat.get("t", 0.0)) < replica_stale_s:
+            return False                 # alive + beating: never broken
+    return None
+
+
+# ---- claim epochs -----------------------------------------------------------
+
+def epoch_path(spool: str, rid: str) -> str:
+    return os.path.join(spool, rid + EPOCH_SUFFIX)
+
+
+def read_epoch(spool: str, rid: str) -> int:
+    """The last claim generation of request ``rid`` (0 = never
+    claimed)."""
+    try:
+        with open(epoch_path(spool, rid), encoding="utf-8") as f:
+            return int(json.load(f).get("epoch", 0))
+    except (OSError, ValueError):
+        return 0
+
+
+def bump_epoch(spool: str, rid: str, lock) -> int:
+    """Advance the claim epoch of ``rid`` and return the new value.
+    MUST be called while ``lock`` (the request's claim lock) is held —
+    the lock serializes the read-modify-write, and the epoch is then
+    stamped into the lock body so the rename guard can compare the two
+    without touching the sidecar."""
+    assert lock is not None and getattr(lock, "_held", True)
+    epoch = read_epoch(spool, rid) + 1
+
+    def write(tmp):
+        with open(tmp, "w") as f:
+            json.dump({"req": rid, "epoch": epoch}, f)
+    atomic_write(epoch_path(spool, rid), write)
+    return epoch
+
+
+def clear_epoch(spool: str, rid: str) -> None:
+    """Drop the epoch sidecar — terminal writers call this right after
+    deleting the request file (a request with a terminal has no next
+    claimant, so the counter is done)."""
+    try:
+        os.remove(epoch_path(spool, rid))
+    except OSError:
+        pass
+
+
+def break_dead_claims(spool: str, replica: str) -> list[str]:
+    """Break every claim lock in ``spool`` whose payload names
+    ``replica`` AND whose holder pid is gone — the re-dispatch move
+    after a replica death.  The request files themselves never moved,
+    so removing the locks IS returning the requests to the queue; the
+    next claimant bumps each epoch and the dead holder's late writes
+    (if it was a zombie, not a corpse) fail the rename guard.  Returns
+    the re-dispatched request ids."""
+    try:
+        names = os.listdir(spool)
+    except OSError:
+        return []
+    freed: list[str] = []
+    for name in sorted(names):
+        if not name.endswith(CLAIM_LOCK_SUFFIX):
+            continue
+        lock_path = os.path.join(spool, name)
+        claim = read_lock_payload(lock_path)
+        if claim.get("replica") != replica:
+            continue
+        pid_s = str(claim.get("pid", ""))
+        if pid_s.isdigit() and pid_alive(int(pid_s)):
+            continue   # relaunched same-name replica's LIVE claim
+        try:
+            os.remove(lock_path)
+        except OSError:
+            continue
+        freed.append(name[:-len(CLAIM_LOCK_SUFFIX)])
+    return freed
+
+
+# ---- the fleet supervisor ---------------------------------------------------
+
+class _Replica:
+    """One supervised replica slot: its specs (chaos first attempt,
+    clean relaunches), the live process, and its attempt counter."""
+
+    __slots__ = ("name", "spec_path", "clean_spec_path", "log_path",
+                 "proc", "attempts", "relaunch_at", "exited_clean")
+
+    def __init__(self, name: str, spec_path: str,
+                 clean_spec_path: str | None = None,
+                 log_path: str | None = None):
+        self.name = name
+        self.spec_path = spec_path
+        self.clean_spec_path = clean_spec_path or spec_path
+        self.log_path = log_path or spec_path + ".log"
+        self.proc = None
+        self.attempts = 0
+        self.relaunch_at: float | None = None
+        self.exited_clean = False
+
+
+class ServeFleet:
+    """Supervise N ``--serve`` replicas against one spool until it
+    drains: heartbeat triage (dead / hung / slow), claim re-dispatch,
+    relaunch with deterministic backoff.  Pure process/file plumbing —
+    no JAX in this process; the replicas do the serving."""
+
+    def __init__(self, spool: str, members: list[_Replica], *,
+                 stale_ms: float | None = None, poll_s: float = 0.05,
+                 max_attempts: int = 3, env: dict | None = None,
+                 backoff_base: float | None = None,
+                 backoff_cap: float | None = None):
+        self.spool = spool
+        self.members = list(members)
+        self.stale_s = pick_replica_stale_ms(stale_ms) / 1e3
+        self.poll_s = float(poll_s)
+        self.max_attempts = int(max_attempts)
+        self.env = dict(env or {})
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.relaunches = 0
+        self.sigkills = 0
+        self.redispatched: list[str] = []
+        self.events: list[dict] = []
+
+    # ---- plumbing ----------------------------------------------------------
+
+    def _event(self, kind: str, rep: _Replica, **extra) -> None:
+        row = {"event": kind, "replica": rep.name,
+               "attempt": rep.attempts, **extra}
+        self.events.append(row)
+        obtrace.instant(f"fleet.replica.{kind}", cat="fleet",
+                        replica=rep.name, **extra)
+
+    def _spawn(self, rep: _Replica) -> None:
+        import subprocess
+        import sys
+        spec = rep.spec_path if rep.attempts == 0 else rep.clean_spec_path
+        env = dict(os.environ)
+        env.update(self.env)
+        # chaos is per-replica and first-attempt-only, riding the spec —
+        # never the inherited environment (same contract as fleet jobs)
+        env.pop("TSNE_FAULT_PLAN", None)
+        argv = [sys.executable, "-m", "tsne_flink_tpu.runtime.fleet",
+                "--serve", spec]
+        log = open(rep.log_path, "ab")
+        try:
+            rep.proc = subprocess.Popen(argv, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()
+        rep.exited_clean = False
+        rep.relaunch_at = None
+        self._event("spawn", rep, pid=rep.proc.pid,
+                    spec=os.path.basename(spec))
+
+    def _pending(self) -> int:
+        try:
+            names = os.listdir(self.spool)
+        except OSError:
+            return 0
+        return sum(1 for n in names if n.endswith(".req.npz"))
+
+    # ---- the triage passes -------------------------------------------------
+
+    def _hung_pass(self) -> None:
+        """SIGKILL replicas whose pid lives but whose beat went stale —
+        the 'hung' row of the triage table.  A replica that has not
+        beaten YET (still importing/compiling) is not judged; the run
+        deadline is its backstop."""
+        for rep in self.members:
+            if rep.proc is None or rep.proc.poll() is not None:
+                continue
+            beat = read_beat(self.spool, rep.name)
+            if beat is None or str(beat.get("pid")) != str(rep.proc.pid):
+                continue
+            beat_age = walltime() - float(beat.get("t", 0.0))
+            if beat_age > self.stale_s:
+                try:
+                    os.kill(rep.proc.pid, signal.SIGKILL)
+                except OSError:
+                    continue   # lost the race with its own exit
+                self.sigkills += 1
+                self._event("sigkill-hung", rep, pid=rep.proc.pid,
+                            beat_age_ms=round(beat_age * 1e3, 1))
+
+    def _reap_pass(self) -> None:
+        """Collect exited replicas: break their dead claims (re-dispatch)
+        and schedule a backoff relaunch for non-clean exits."""
+        from tsne_flink_tpu.runtime.supervisor import backoff_seconds
+        for rep in self.members:
+            if rep.proc is None or rep.proc.poll() is None:
+                continue
+            rc = rep.proc.returncode
+            freed = break_dead_claims(self.spool, rep.name)
+            self.redispatched.extend(freed)
+            self._event("exit", rep, rc=rc, redispatched=freed)
+            rep.proc = None
+            if rc == 0:
+                rep.exited_clean = True
+                continue
+            if rep.attempts + 1 >= self.max_attempts:
+                self._event("gave-up", rep, rc=rc)
+                continue
+            rep.attempts += 1
+            delay = backoff_seconds(rep.attempts - 1, self.backoff_base,
+                                    self.backoff_cap, token=rep.name)
+            rep.relaunch_at = walltime() + delay
+            self._event("relaunch-scheduled", rep,
+                        delay_ms=round(delay * 1e3, 1))
+
+    def _relaunch_pass(self, now: float) -> None:
+        for rep in self.members:
+            if rep.relaunch_at is not None and now >= rep.relaunch_at:
+                self.relaunches += 1
+                self._spawn(rep)
+        if self._pending() and not any(
+                rep.proc is not None or rep.relaunch_at is not None
+                for rep in self.members):
+            # work remains but everyone idle-exited (a late submission
+            # raced the drain): bring one clean replica back
+            for rep in self.members:
+                if rep.exited_clean and rep.attempts < self.max_attempts:
+                    rep.attempts += 1
+                    self.relaunches += 1
+                    self._spawn(rep)
+                    break
+
+    def _done(self) -> bool:
+        return (self._pending() == 0
+                and all(rep.proc is None and rep.relaunch_at is None
+                        for rep in self.members))
+
+    def _halt(self) -> None:
+        """Deadline epilogue: SIGKILL stragglers so the final reap can
+        break their claims and the record says what really happened."""
+        for rep in self.members:
+            if rep.proc is not None and rep.proc.poll() is None:
+                try:
+                    os.kill(rep.proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                self._event("sigkill-deadline", rep, pid=rep.proc.pid)
+        for rep in self.members:
+            if rep.proc is not None:
+                rep.proc.wait()
+
+    # ---- the loop ----------------------------------------------------------
+
+    def run(self, run_s: float) -> dict:
+        """Spawn every member, supervise until the spool drains and all
+        replicas exit (or ``run_s`` elapses — then SIGKILL stragglers),
+        sweep the heartbeat files, and return the fleet record."""
+        t0 = walltime()
+        with obtrace.span("fleet.serve", cat="fleet",
+                          replicas=len(self.members)):
+            for rep in self.members:
+                self._spawn(rep)
+            deadline_hit = False
+            while True:
+                self._hung_pass()
+                self._reap_pass()
+                now = walltime()
+                self._relaunch_pass(now)
+                if self._done():
+                    break
+                if now - t0 > float(run_s):
+                    deadline_hit = True
+                    self._halt()
+                    self._reap_pass()
+                    break
+                time.sleep(self.poll_s)
+        clear_beats(self.spool)
+        return {"replicas": [rep.name for rep in self.members],
+                "attempts": {rep.name: rep.attempts + 1
+                             for rep in self.members},
+                "relaunches": self.relaunches,
+                "sigkills": self.sigkills,
+                "redispatched": sorted(set(self.redispatched)),
+                "deadline_hit": deadline_hit,
+                "stale_ms": round(self.stale_s * 1e3, 3),
+                "seconds": round(walltime() - t0, 3),
+                "events": list(self.events)}

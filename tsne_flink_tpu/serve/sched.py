@@ -69,6 +69,11 @@ SCHED_RECORD_KEYS = (
     "sched", "deadline_ms", "starve_ms", "poll_ms", "queue_ms",
     "compute_ms", "write_ms", "batch_fill", "lane", "slices", "spool",
     "promoted", "batches", "residency", "seconds",
+    # graftquorum: replica identity + claim epoch on latency records,
+    # fleet triage/shedding knobs and counters on summaries and the
+    # bench serve_fleet block
+    "replica", "epoch", "replicas", "stale_ms", "shed", "shed_depth",
+    "retry_after_ms", "redispatched",
 )
 
 
@@ -122,12 +127,12 @@ class Request:
     __slots__ = ("rid", "path", "lock", "x", "model_id", "rows",
                  "arrival", "deadline", "seq", "lane", "poll_ms",
                  "next_row", "done_rows", "out", "slices", "fills",
-                 "first_dispatch", "compute_done", "promoted")
+                 "first_dispatch", "compute_done", "promoted", "epoch")
 
     def __init__(self, rid: str, path: str, lock, x: np.ndarray,
                  model_id: str, *, arrival: float, deadline_s: float,
                  seq: int, bucket: int, out_width: int,
-                 out_dtype, poll_ms: float):
+                 out_dtype, poll_ms: float, epoch: int = 0):
         self.rid = rid
         self.path = path
         self.lock = lock
@@ -154,6 +159,9 @@ class Request:
         self.first_dispatch: float | None = None
         self.compute_done: float | None = None
         self.promoted = False
+        # graftquorum claim generation (0 = unclaimed/legacy): stamped
+        # at claim, checked by the result writer's rename guard
+        self.epoch = int(epoch)
 
     def complete(self) -> bool:
         return self.done_rows >= self.rows

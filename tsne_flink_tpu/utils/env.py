@@ -184,8 +184,10 @@ _declare("TSNE_FAULT_PLAN", "str", None,
          "(synthetic RESOURCE_EXHAUSTED), kill (SIGKILL at a segment "
          "boundary), corrupt (bit-flip the just-written checkpoint), nan "
          "(poison a segment's input state), delay (sleep "
-         "TSNE_FAULT_DELAY_S at the site — latency chaos). Fleet chaos "
-         "plans additionally take kind@job:N clauses (runtime/fleet.py). "
+         "TSNE_FAULT_DELAY_S at the site — latency chaos), hang (block "
+         "forever at the site entry — the hung-replica failure mode the "
+         "graftquorum heartbeat triage catches). Fleet chaos plans "
+         "additionally take kind@job:N clauses (runtime/fleet.py). "
          "Testing only; unset in production.")
 _declare("TSNE_ON_OOM", "str", "ladder",
          "Bench default for the supervisor's device-OOM policy: 'ladder' "
@@ -324,6 +326,30 @@ _declare("TSNE_SERVE_POLL_MAX_MS", "float", 1000.0,
          "doubles each empty scan up to POLL_MAX_MS, so an idle daemon "
          "stops burning CPU. The interval in effect at claim time rides "
          "latency records as 'poll_ms'.")
+
+# ---- graftquorum (tsne_flink_tpu/serve/replicas.py) ------------------------
+_declare("TSNE_SERVE_REPLICAS", "int", 2,
+         "Replica count of the serve fleet (runtime/fleet.py "
+         "--serve-fleet): N serve daemons run against ONE shared spool, "
+         "with FileLock claims as the dispatch mechanism and heartbeat "
+         "files driving dead/hung/slow triage (serve/replicas.py). Rides "
+         "the fleet record and the bench serve_fleet block as 'replicas'.")
+_declare("TSNE_REPLICA_STALE_MS", "float", 5000.0,
+         "Heartbeat staleness bound of the graftquorum failure triage "
+         "(serve/replicas.py): a replica whose <name>.beat.json is older "
+         "than this while its pid lives is HUNG (the fleet supervisor "
+         "SIGKILLs it and breaks its claims); a fresher beat marks it "
+         "merely slow and protects its claims from the stale-break — a "
+         "GC-pausing replica is never double-served. Rides serve "
+         "summaries as 'stale_ms'.")
+_declare("TSNE_SERVE_SHED_DEPTH", "int", 0,
+         "Overload brownout threshold of the serve fleet: when the "
+         "shared spool's pending backlog exceeds this many requests, "
+         "bulk-lane (multi-bucket) requests get a fast .err.json refusal "
+         "carrying retry_after_ms instead of unbounded queue growth; "
+         "express-lane requests are never shed before bulk. 0 (default) "
+         "disables shedding. Rides serve summaries as 'shed_depth', "
+         "refusal counts as 'shed'.")
 
 # ---- caches ----------------------------------------------------------------
 _declare("TSNE_ARTIFACTS", "bool", True,

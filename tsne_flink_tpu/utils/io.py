@@ -25,15 +25,21 @@ import numpy as np
 from tsne_flink_tpu.utils import native as _native
 
 
-def atomic_write(path: str, write_fn) -> None:
+def atomic_write(path: str, write_fn, *, tag: str | None = None) -> None:
     """tmp + rename write: ``write_fn(tmp_path)`` produces the content,
     which is then atomically renamed into place — a kill mid-write can
     never leave a truncated embedding/loss/record file for downstream
     harvesting to choke on (the same contract utils/checkpoint.py and
-    utils/artifacts.py already keep for their files)."""
+    utils/artifacts.py already keep for their files).  ``tag`` names the
+    tmp (``.<tag>.out.tmp``) so concurrent writers of the SAME target are
+    distinguishable on disk — the graftquorum claim-epoch rename guard
+    suffixes the claim epoch here, and a ``write_fn`` that raises (the
+    guard's stale-claim verdict) aborts BEFORE the rename: the tmp is
+    unlinked and the target never changes."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".out.tmp")
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=f".{tag}.out.tmp" if tag
+                               else ".out.tmp")
     os.close(fd)
     try:
         write_fn(tmp)

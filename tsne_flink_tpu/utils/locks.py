@@ -10,11 +10,18 @@ process may be mid-``load`` of the first.  :class:`FileLock` serializes
 the write side per cache key with the oldest portable primitive there is:
 
 * **acquire** = ``os.open(path, O_CREAT | O_EXCL)`` — atomic on every
-  POSIX filesystem; the file body records ``pid`` for post-mortems;
+  POSIX filesystem; the file body records ``pid`` (plus any caller
+  ``payload`` lines — the graftquorum claim protocol stores its replica
+  name and claim epoch here, see :func:`read_lock_payload`);
 * **stale-lock timeout** — a writer that died mid-hold (SIGKILL chaos is
   a first-class citizen here) leaves its lock behind; any acquirer that
   finds a lock older than ``TSNE_LOCK_STALE_S`` breaks it and retries,
-  so an abandoned lock costs one timeout, never a deadlock;
+  so an abandoned lock costs one timeout, never a deadlock.  A
+  ``stale_fn`` hook refines the verdict beyond pure age: the serve
+  daemon folds in holder pid-aliveness and heartbeat freshness
+  (``serve/replicas.claim_stale_verdict``) so a slow-but-alive holder
+  is never broken mid-write while a dead holder's claim breaks
+  immediately;
 * **bounded wait** — :meth:`acquire` polls up to ``timeout_s`` and then
   returns False instead of raising: for content-addressed writes the
   holder is producing the SAME bytes, so "someone else is writing this
@@ -51,18 +58,53 @@ LOCK_SUFFIX = ".lock"
 DEFAULT_TIMEOUT_S = 5.0
 
 
+def read_lock_payload(path: str) -> dict:
+    """The ``key=value`` lines of a lock file as a dict — empty when the
+    lock is gone or torn (both mean "no live claim to honour").  The
+    claim protocol stores ``pid``, ``replica`` and ``epoch`` here; the
+    stale-break policy and the epoch rename-guard both read it."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return {}
+    out: dict = {}
+    for line in text.splitlines():
+        key, sep, val = line.partition("=")
+        if sep:
+            out[key.strip()] = val.strip()
+    return out
+
+
 class FileLock:
-    """One advisory cross-process lock backed by an O_EXCL lock file."""
+    """One advisory cross-process lock backed by an O_EXCL lock file.
+
+    ``payload`` adds ``key=value`` lines to the lock body at acquisition
+    (and marks the lock claim-style: :meth:`release` then verifies the
+    body still names THIS pid before removing, so a holder whose claim
+    was stale-broken and re-acquired never deletes the new owner's
+    lock).  ``stale_fn(path, age) -> bool | None`` refines the
+    stale-break verdict: True breaks now regardless of age, False never
+    breaks, None falls back to the age rule."""
 
     def __init__(self, path: str, stale_s: float | None = None,
-                 poll_s: float = 0.02):
+                 poll_s: float = 0.02, payload: dict | None = None,
+                 stale_fn=None):
         self.path = path
         self.stale_s = (float(env_float("TSNE_LOCK_STALE_S"))
                         if stale_s is None else float(stale_s))
         self.poll_s = float(poll_s)
+        self.payload = dict(payload) if payload else None
+        self.stale_fn = stale_fn
         self._held = False
 
     # ---- protocol ----------------------------------------------------------
+
+    def _body(self) -> bytes:
+        lines = [f"pid={os.getpid()}\n"]
+        for key in sorted(self.payload or {}):
+            lines.append(f"{key}={self.payload[key]}\n")
+        return "".join(lines).encode()
 
     def _try_once(self) -> bool:
         try:
@@ -74,17 +116,45 @@ class FileLock:
             # are best-effort and their writes already tolerate skipping
             return False
         try:
-            os.write(fd, f"pid={os.getpid()}\n".encode())
+            os.write(fd, self._body())
         finally:
             os.close(fd)
         self._held = True
         return True
+
+    def write_payload(self, extra: dict) -> None:
+        """Rewrite the held lock's body with updated payload lines (the
+        claim protocol stamps the claim epoch here AFTER acquisition —
+        the epoch is only known once the sidecar is read under the
+        lock).  One small write; concurrent readers parse line-wise and
+        treat a torn body as an anonymous claim, which only ever makes
+        them MORE conservative."""
+        if not self._held:
+            return
+        self.payload = dict(self.payload or {})
+        self.payload.update(extra)
+        try:
+            with open(self.path, "wb") as f:
+                f.write(self._body())
+        except OSError:
+            pass  # body is advisory metadata; the lock file is the lock
 
     def _break_if_stale(self) -> None:
         try:
             age = walltime() - os.path.getmtime(self.path)
         except OSError:
             return  # holder released between our check and the stat
+        if self.stale_fn is not None:
+            verdict = self.stale_fn(self.path, age)
+            if verdict is False:
+                return   # holder is alive and beating: never broken
+            if verdict is True:
+                try:
+                    os.remove(self.path)  # dead holder: break NOW
+                except OSError:
+                    pass
+                return
+            # verdict None: no evidence either way — the age rule decides
         if age > self.stale_s:
             try:
                 os.remove(self.path)  # break: the writer died mid-hold
@@ -109,6 +179,12 @@ class FileLock:
         if not self._held:
             return
         self._held = False
+        if self.payload is not None:
+            # claim-style lock: only remove a body that still names US —
+            # a stale-broken + re-acquired lock belongs to the new owner
+            owner = read_lock_payload(self.path).get("pid")
+            if owner is not None and owner != str(os.getpid()):
+                return
         try:
             os.remove(self.path)
         except OSError:
